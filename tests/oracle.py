@@ -1,0 +1,152 @@
+"""Brute-force oracle: a literal per-tuple simulation of the reference
+Win_Seq state machine (win_seq.hpp:268-474, window.hpp).  Deliberately slow
+and obvious — used only to differentially validate the vectorised engine.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+
+
+class OracleWinSeq:
+    def __init__(self, win_len, slide_len, win_type, func, is_nic,
+                 config=None, role="SEQ", map_indexes=(0, 1)):
+        # config = (id_outer, n_outer, slide_outer, id_inner, n_inner, slide_inner)
+        self.win = win_len
+        self.slide = slide_len
+        self.wt = win_type  # "CB" | "TB"
+        self.func = func    # NIC: f(key,gwid,rows)->value ; INC: f(key,gwid,row,acc)->acc
+        self.is_nic = is_nic
+        self.cfg = config or (0, 1, slide_len, 0, 1, slide_len)
+        self.role = role
+        self.map_indexes = map_indexes
+        self.keys = {}
+
+    def _kd(self, key):
+        kd = self.keys.get(key)
+        if kd is None:
+            io, no, so, ii, ni, si = self.cfg
+            first_gwid = ((ii - (key % ni) + ni) % ni) * no + (io - (key % no) + no) % no
+            init_outer = ((io - (key % no) + no) % no) * so
+            init_inner = ((ii - (key % ni) + ni) % ni) * si
+            initial = init_inner if self.role in ("WLQ", "REDUCE") else init_outer + init_inner
+            kd = {
+                "archive": [],  # list of (pos, rowdict) sorted by pos
+                "wins": [],     # list of window dicts, in lwid order
+                "next_lwid": 0,
+                "rcv": 0,
+                "last_pos": None,
+                "emit": self.map_indexes[0] if self.role == "MAP" else 0,
+                "first_gwid": first_gwid,
+                "initial": initial,
+            }
+            self.keys[key] = kd
+        return kd
+
+    def _emit(self, key, kd, w, rows_or_acc):
+        if self.is_nic:
+            value = self.func(key, w["gwid"], rows_or_acc)
+        else:
+            value = rows_or_acc
+        rid = w["gwid"]
+        if self.role == "MAP":
+            rid = kd["emit"]
+            kd["emit"] += self.map_indexes[1]
+        elif self.role == "PLQ":
+            io, no, so, ii, ni, si = self.cfg
+            rid = ((ii - (key % ni) + ni) % ni) + kd["emit"] * ni
+            kd["emit"] += 1
+        return {"key": key, "id": rid, "ts": w["result_ts"], "value": value}
+
+    def push(self, key, id, ts, marker=False, value=0):
+        out = []
+        kd = self._kd(key)
+        pos = id if self.wt == "CB" else ts
+        if kd["last_pos"] is not None and pos < kd["last_pos"]:
+            return out
+        kd["rcv"] += 1
+        kd["last_pos"] = pos
+        initial = kd["initial"]
+        if pos < initial:
+            return out
+        io, no, so, ii, ni, si = self.cfg
+        # last window containing pos
+        if self.win >= self.slide:
+            last_w = math.ceil((pos + 1 - initial) / self.slide) - 1
+        else:
+            n = (pos - initial) // self.slide
+            last_w = n
+            if (pos - initial < n * self.slide) or (pos - initial >= n * self.slide + self.win):
+                if not marker:
+                    return out
+        row = {"key": key, "id": id, "ts": ts, "value": value}
+        if not marker and self.is_nic:
+            poslist = [p for p, _ in kd["archive"]]
+            i = bisect.bisect_left(poslist, pos)
+            kd["archive"].insert(i, (pos, row))
+        # create new windows
+        while kd["next_lwid"] <= last_w:
+            lwid = kd["next_lwid"]
+            gwid = kd["first_gwid"] + lwid * no * ni
+            w = {
+                "lwid": lwid, "gwid": gwid,
+                "result_ts": (gwid * self.slide + self.win - 1) if self.wt == "TB" else 0,
+                "acc": None if self.is_nic else self.func(key, gwid, None, None),
+                "first_pos": None, "firing_pos": None,
+            }
+            kd["wins"].append(w)
+            kd["next_lwid"] += 1
+        # evaluate open windows
+        fired = 0
+        for w in kd["wins"]:
+            if self.wt == "CB":
+                is_fired = id > (self.win + w["lwid"] * self.slide - 1) + initial
+            else:
+                is_fired = ts >= (self.win + w["lwid"] * self.slide) + initial
+            if not is_fired:
+                # CONTINUE
+                if w["first_pos"] is None:
+                    w["first_pos"] = pos
+                if self.wt == "CB":
+                    w["result_ts"] = ts
+                if not self.is_nic and not marker:
+                    w["acc"] = self.func(key, w["gwid"], row, w["acc"])
+            else:
+                if w["firing_pos"] is None:
+                    w["firing_pos"] = pos
+                if self.is_nic:
+                    if w["first_pos"] is None:
+                        rows = []
+                    else:
+                        poslist = [p for p, _ in kd["archive"]]
+                        lo = bisect.bisect_left(poslist, w["first_pos"])
+                        hi = bisect.bisect_left(poslist, w["firing_pos"])
+                        rows = [r for _, r in kd["archive"][lo:hi]]
+                    out.append(self._emit(key, kd, w, rows))
+                    if w["first_pos"] is not None:
+                        poslist = [p for p, _ in kd["archive"]]
+                        cut = bisect.bisect_left(poslist, w["first_pos"])
+                        kd["archive"] = kd["archive"][cut:]
+                else:
+                    out.append(self._emit(key, kd, w, w["acc"]))
+                fired += 1
+        kd["wins"] = kd["wins"][fired:]
+        return out
+
+    def eos(self):
+        out = []
+        for key, kd in self.keys.items():
+            for w in kd["wins"]:
+                if self.is_nic:
+                    if w["first_pos"] is None:
+                        rows = []
+                    else:
+                        poslist = [p for p, _ in kd["archive"]]
+                        lo = bisect.bisect_left(poslist, w["first_pos"])
+                        rows = [r for _, r in kd["archive"][lo:]]
+                    out.append(self._emit(key, kd, w, rows))
+                else:
+                    out.append(self._emit(key, kd, w, w["acc"]))
+            kd["wins"] = []
+        return out

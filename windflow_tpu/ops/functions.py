@@ -1,0 +1,202 @@
+"""Window-function contracts: non-incremental, incremental, and batched.
+
+The reference supports two user-function shapes per window pattern
+(``win_seq.hpp:116-117``):
+
+* non-incremental (NIC): ``winFunction(key, gwid, Iterable<tuple>, result&)``
+  evaluated over the whole window content on fire;
+* incremental (INC): ``winUpdate(key, gwid, tuple, result&)`` folded per
+  tuple as it arrives.
+
+Its GPU path additionally requires a CUDA-compilable functor over flat arrays
+(``win_seq_gpu.hpp:54-67``): ``F(key, gwid, data*, result*, size, scratch*)``.
+
+A TPU cannot JIT arbitrary host C++/Python per window, so this framework
+defines the device contract at the *batch* level: a window function may
+provide ``apply_batch(keys, gwids, cols, lens)`` where ``cols`` maps each
+payload field to a ``(n_windows, pad_len)`` array and ``lens`` gives the
+valid prefix per window.  Built-in monoid reducers implement all three
+shapes; arbitrary user JAX functions are wrapped by :class:`JaxWindowFunction`
+which vmaps them over the window batch; arbitrary Python functions fall back
+to the host path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WindowFunction:
+    """Non-incremental window function (host contract).
+
+    Subclasses implement :meth:`apply`; implementing :meth:`apply_batch`
+    opts into the batched/device path.
+    """
+
+    #: name -> numpy dtype of the produced result payload
+    result_fields: dict
+
+    def apply(self, key: int, gwid: int, rows: np.ndarray) -> tuple:
+        """Evaluate one window. `rows` is a structured array of the tuples in
+        the window (possibly empty). Returns the result payload values in
+        `result_fields` order."""
+        raise NotImplementedError
+
+    def apply_batch(self, keys, gwids, cols, lens):
+        """Optional vectorised evaluation of many windows at once.
+
+        cols: {field: (n, pad)} padded columns; lens: (n,) valid lengths.
+        Returns {field: (n,)} result payload columns. Padding rows are zeros.
+        """
+        raise NotImplementedError
+
+    @property
+    def supports_batch(self) -> bool:
+        return type(self).apply_batch is not WindowFunction.apply_batch
+
+
+class WindowUpdate:
+    """Incremental per-tuple fold (host contract, O(1) state per window)."""
+
+    result_fields: dict
+
+    def init(self, key: int, gwid: int) -> np.void:
+        """Fresh accumulator record (defaults to zeros)."""
+        dt = np.dtype([(k, v) for k, v in self.result_fields.items()])
+        return np.zeros((), dtype=dt)
+
+    def update(self, key: int, gwid: int, row: np.void, acc: np.void) -> None:
+        raise NotImplementedError
+
+    def update_many(self, key: int, gwid: int, rows: np.ndarray, acc: np.void) -> None:
+        """Fold a chunk of in-order rows; default is a per-row loop —
+        monoid reducers override with a vectorised fold."""
+        for row in rows:
+            self.update(key, gwid, row, acc)
+
+
+class FnWindowFunction(WindowFunction):
+    """Adapts a plain Python callable ``fn(key, gwid, rows) -> value(s)``."""
+
+    def __init__(self, fn, result_fields):
+        self.fn = fn
+        self.result_fields = dict(result_fields)
+
+    def apply(self, key, gwid, rows):
+        out = self.fn(key, gwid, rows)
+        return out if isinstance(out, tuple) else (out,)
+
+
+class FnWindowUpdate(WindowUpdate):
+    """Adapts a plain Python callable ``fn(key, gwid, row, acc) -> None``."""
+
+    def __init__(self, fn, result_fields):
+        self.fn = fn
+        self.result_fields = dict(result_fields)
+
+    def update(self, key, gwid, row, acc):
+        self.fn(key, gwid, row, acc)
+
+
+_UFUNCS = {
+    "sum": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+    "prod": np.multiply,
+}
+
+
+class Reducer(WindowFunction, WindowUpdate):
+    """Built-in monoid reduction over one payload field.
+
+    Serves as NIC function, INC update, *and* batched/device function —
+    the three are algebraically identical for a monoid, which the
+    differential tests rely on (mirroring the reference's NIC/INC parity
+    in ``src/sum_test_cpu/test_all_cb.cpp``).
+    """
+
+    def __init__(self, op: str, field: str = "value", out_field: str = None,
+                 dtype=np.int64):
+        if op == "count":
+            self.ufunc = None
+        else:
+            self.ufunc = _UFUNCS[op]
+        self.op = op
+        self.field = field
+        self.out_field = out_field or field
+        self.dtype = np.dtype(dtype)
+        self.result_fields = {self.out_field: self.dtype}
+
+    # identity element for empty windows / fresh accumulators
+    def _identity(self):
+        if self.op in ("sum", "count"):
+            return 0
+        if self.op == "prod":
+            return 1
+        if self.op == "min":
+            return np.iinfo(self.dtype).max if self.dtype.kind in "iu" else np.inf
+        if self.op == "max":
+            return np.iinfo(self.dtype).min if self.dtype.kind in "iu" else -np.inf
+
+    # --- NIC ---
+    def apply(self, key, gwid, rows):
+        if self.op == "count":
+            return (len(rows),)
+        if len(rows) == 0:
+            return (self.dtype.type(self._identity()),)
+        return (self.ufunc.reduce(rows[self.field].astype(self.dtype)),)
+
+    def apply_batch(self, keys, gwids, cols, lens):
+        n, pad = next(iter(cols.values())).shape if cols else (len(lens), 0)
+        if self.op == "count":
+            return {self.out_field: lens.astype(self.dtype)}
+        vals = cols[self.field].astype(self.dtype)
+        mask = np.arange(pad)[None, :] < lens[:, None]
+        ident = self.dtype.type(self._identity())
+        vals = np.where(mask, vals, ident)
+        return {self.out_field: self.ufunc.reduce(vals, axis=1)}
+
+    # --- INC ---
+    def init(self, key, gwid):
+        acc = np.zeros((), dtype=np.dtype([(self.out_field, self.dtype)]))
+        acc[self.out_field] = self._identity()
+        return acc
+
+    def update(self, key, gwid, row, acc):
+        if self.op == "count":
+            acc[self.out_field] += 1
+        else:
+            acc[self.out_field] = self.ufunc(
+                acc[self.out_field], self.dtype.type(row[self.field]))
+
+    def update_many(self, key, gwid, rows, acc):
+        if self.op == "count":
+            acc[self.out_field] += len(rows)
+        elif len(rows):
+            acc[self.out_field] = self.ufunc(
+                acc[self.out_field],
+                self.ufunc.reduce(rows[self.field].astype(self.dtype)))
+
+    @property
+    def supports_batch(self):
+        return True
+
+
+def as_window_function(f, result_fields=None) -> WindowFunction:
+    if isinstance(f, WindowFunction):
+        return f
+    if callable(f):
+        if result_fields is None:
+            raise ValueError("result_fields required for a plain callable")
+        return FnWindowFunction(f, result_fields)
+    raise TypeError(f"cannot interpret {f!r} as a window function")
+
+
+def as_window_update(f, result_fields=None) -> WindowUpdate:
+    if isinstance(f, WindowUpdate):
+        return f
+    if callable(f):
+        if result_fields is None:
+            raise ValueError("result_fields required for a plain callable")
+        return FnWindowUpdate(f, result_fields)
+    raise TypeError(f"cannot interpret {f!r} as a window update")
