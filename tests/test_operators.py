@@ -1,0 +1,227 @@
+"""Operator tests mirroring the reference suites src/{source,map,filter,
+flatmap,accumulator,sink}_test: every functor flavour per operator, plus a
+micro pipeline (src/microbenchmarks/test_micro_1.cpp)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.patterns.basic import (Accumulator, Filter, FlatMap, Map,
+                                         Sink, Source)
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import build_pipeline
+
+SCHEMA = Schema(value=np.int64)
+
+
+def int_stream(n, keys=1, chunk=64):
+    """Batches of the deterministic integer stream (ids 0..n-1 per key)."""
+    out = []
+    for i in range(0, n, chunk):
+        ids = np.repeat(np.arange(i, min(i + chunk, n)), keys)
+        ks = np.tile(np.arange(keys), len(ids) // keys)
+        out.append(batch_from_columns(SCHEMA, key=ks, id=ids, ts=ids, value=ids))
+    return out
+
+
+class Gather:
+    """Thread-safe sink collector for tests."""
+
+    def __init__(self):
+        self.rows = []
+        self.eos_calls = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, row):
+        with self._lock:
+            if row is None:
+                self.eos_calls += 1
+            else:
+                self.rows.append((int(row["key"]), int(row["id"]),
+                                  int(row["value"])))
+
+
+def run_pipe(*patterns):
+    df = Dataflow()
+    build_pipeline(df, list(patterns))
+    df.run_and_wait_end()
+
+
+# ------------------------------------------------------------------- sources
+
+def test_source_itemized():
+    state = {"i": 0}
+
+    def gen(row):
+        row["id"] = row["value"] = state["i"]
+        state["i"] += 1
+        return state["i"] < 100
+
+    got = Gather()
+    run_pipe(Source(gen, SCHEMA, itemized=True), Sink(got))
+    assert [r[1] for r in got.rows] == list(range(100))
+    assert got.eos_calls == 1
+
+
+def test_source_loop_shipper():
+    def gen(shipper):
+        for i in range(50):
+            shipper.push(key=i % 2, id=i, ts=i, value=i * 2)
+
+    got = Gather()
+    run_pipe(Source(gen, SCHEMA), Sink(got))
+    assert sorted(r[2] for r in got.rows) == [i * 2 for i in range(50)]
+
+
+def test_source_rich_parallel():
+    def gen(shipper, ctx):
+        base = ctx.getReplicaIndex() * 100
+        for i in range(10):
+            shipper.push(key=ctx.getReplicaIndex(), id=base + i, value=1)
+
+    got = Gather()
+    run_pipe(Source(gen, SCHEMA, parallelism=4, rich=True), Sink(got))
+    assert len(got.rows) == 40
+    assert {r[0] for r in got.rows} == {0, 1, 2, 3}
+
+
+# ---------------------------------------------------------------------- maps
+
+@pytest.mark.parametrize("parallelism", [1, 3])
+def test_map_inplace(parallelism):
+    def double(row):
+        row["value"] *= 2
+
+    got = Gather()
+    run_pipe(Source(batches=int_stream(100), schema=SCHEMA),
+             Map(double, parallelism=parallelism), Sink(got))
+    assert sorted(r[2] for r in got.rows) == [2 * i for i in range(100)]
+
+
+def test_map_non_inplace_new_schema():
+    out_schema = Schema(squared=np.int64)
+
+    def sq(row, out):
+        out["squared"] = row["value"] ** 2
+
+    rows = []
+    run_pipe(Source(batches=int_stream(20), schema=SCHEMA),
+             Map(sq, output_schema=out_schema),
+             Sink(lambda r: rows.append(int(r["squared"])) if r is not None else None))
+    assert sorted(rows) == [i * i for i in range(20)]
+
+
+def test_map_vectorized_and_rich():
+    def vfn(batch, ctx):
+        batch["value"] += ctx.getParallelism()
+
+    got = Gather()
+    run_pipe(Source(batches=int_stream(30), schema=SCHEMA),
+             Map(vfn, parallelism=2, vectorized=True, rich=True), Sink(got))
+    assert sorted(r[2] for r in got.rows) == [i + 2 for i in range(30)]
+
+
+def test_map_keyed_routing_preserves_per_key_order():
+    def ident(row):
+        pass
+
+    per_key = {}
+
+    def snk(row):
+        if row is not None:
+            per_key.setdefault(int(row["key"]), []).append(int(row["id"]))
+
+    run_pipe(Source(batches=int_stream(200, keys=4, chunk=16), schema=SCHEMA),
+             Map(ident, parallelism=3, keyed=True), Sink(snk))
+    for ids in per_key.values():
+        assert ids == sorted(ids)
+
+
+# -------------------------------------------------------------------- filter
+
+@pytest.mark.parametrize("vectorized", [False, True])
+def test_filter(vectorized):
+    fn = (lambda b: b["value"] % 2 == 0) if vectorized else \
+         (lambda r: r["value"] % 2 == 0)
+    got = Gather()
+    run_pipe(Source(batches=int_stream(100), schema=SCHEMA),
+             Filter(fn, vectorized=vectorized), Sink(got))
+    assert sorted(r[2] for r in got.rows) == [i for i in range(100) if i % 2 == 0]
+
+
+# ------------------------------------------------------------------- flatmap
+
+def test_flatmap_one_to_many():
+    def fm(row, shipper):
+        for j in range(int(row["value"]) % 3):
+            shipper.push(key=row["key"], id=row["id"], value=j)
+
+    got = Gather()
+    run_pipe(Source(batches=int_stream(30), schema=SCHEMA),
+             FlatMap(fm, SCHEMA), Sink(got))
+    assert len(got.rows) == sum(i % 3 for i in range(30))
+
+
+def test_flatmap_vectorized():
+    def fm(batch, shipper):
+        shipper.push_batch(np.concatenate([batch, batch]))
+
+    got = Gather()
+    run_pipe(Source(batches=int_stream(25), schema=SCHEMA),
+             FlatMap(fm, SCHEMA, vectorized=True), Sink(got))
+    assert len(got.rows) == 50
+
+
+# --------------------------------------------------------------- accumulator
+
+def test_accumulator_running_sum():
+    def acc_fn(row, acc):
+        acc["value"] += row["value"]
+
+    per_key = {}
+
+    def snk(row):
+        if row is not None:
+            per_key.setdefault(int(row["key"]), []).append(int(row["value"]))
+
+    run_pipe(Source(batches=int_stream(40, keys=2, chunk=8), schema=SCHEMA),
+             Accumulator(acc_fn, SCHEMA, parallelism=2), Sink(snk))
+    expect = list(np.cumsum(np.arange(40)))
+    assert per_key[0] == expect and per_key[1] == expect
+
+
+# --------------------------------------------------------------------- pipes
+
+def test_micro_pipeline():
+    """Source -> Map -> Filter -> FlatMap -> Sink with mixed parallelism
+    (test_micro_1.cpp shape)."""
+    def double(row):
+        row["value"] *= 2
+
+    def keep_mod4(row):
+        return row["value"] % 4 == 0
+
+    def dup(row, shipper):
+        shipper.push(key=row["key"], id=row["id"], value=row["value"])
+        shipper.push(key=row["key"], id=row["id"], value=row["value"] + 1)
+
+    got = Gather()
+    run_pipe(Source(batches=int_stream(200), schema=SCHEMA),
+             Map(double, parallelism=2),
+             Filter(keep_mod4, parallelism=3),
+             FlatMap(dup, SCHEMA, parallelism=2),
+             Sink(got))
+    kept = [2 * i for i in range(200) if (2 * i) % 4 == 0]
+    assert sorted(r[2] for r in got.rows) == sorted(
+        [v for v in kept] + [v + 1 for v in kept])
+
+
+def test_engine_error_propagates():
+    def boom(row):
+        raise RuntimeError("user function failed")
+
+    with pytest.raises(RuntimeError, match="user function failed"):
+        run_pipe(Source(batches=int_stream(10), schema=SCHEMA),
+                 Map(boom), Sink(lambda r: None))

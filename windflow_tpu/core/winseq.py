@@ -289,10 +289,10 @@ class WinSeqCore:
                              max(len(arch) - 1, 0))
             pad_mask = np.arange(max(pad, 1))[None, :] >= lens[:, None]
             cols_in = {}
-            skip = {MARKER_FIELD}
-            for name in arch.dtype.names:
-                if name in skip:
-                    continue
+            req = getattr(self.winfunc, "required_fields", None)
+            names = (tuple(req) if req is not None
+                     else tuple(n for n in arch.dtype.names if n != MARKER_FIELD))
+            for name in names:
                 if len(arch):
                     col = arch[name][idx]
                     # honour the apply_batch contract: padding slots are zeros

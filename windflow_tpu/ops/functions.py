@@ -35,6 +35,9 @@ class WindowFunction:
 
     #: name -> numpy dtype of the produced result payload
     result_fields: dict
+    #: input columns apply_batch needs (None = all); declaring them lets the
+    #: engine gather/stage only what the function reads
+    required_fields = None
 
     def apply(self, key: int, gwid: int, rows: np.ndarray) -> tuple:
         """Evaluate one window. `rows` is a structured array of the tuples in
@@ -126,6 +129,7 @@ class Reducer(WindowFunction, WindowUpdate):
         self.out_field = out_field or field
         self.dtype = np.dtype(dtype)
         self.result_fields = {self.out_field: self.dtype}
+        self.required_fields = () if op == "count" else (self.field,)
 
     # identity element for empty windows / fresh accumulators
     def _identity(self):

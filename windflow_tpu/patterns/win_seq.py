@@ -1,0 +1,74 @@
+"""Win_Seq pattern: the sequential window core as a dataflow node
+(reference win_seq.hpp — also the building block of every windowed farm).
+"""
+
+from __future__ import annotations
+
+from ..core.windows import PatternConfig, Role, WindowSpec, WinType
+from ..core.winseq import WinSeqCore
+from ..ops.functions import WindowFunction, WindowUpdate, as_window_function, as_window_update
+from ..runtime.node import Node, RuntimeContext
+from .basic import _Pattern
+
+
+class WinSeqNode(Node):
+    """Runtime node driving a WinSeqCore."""
+
+    def __init__(self, core: WinSeqCore, name="win_seq"):
+        super().__init__(name)
+        self.core = core
+
+    def svc(self, batch, channel=0):
+        out = self.core.process(batch)
+        if len(out):
+            self.emit(out)
+
+    def eosnotify(self):
+        out = self.core.flush()
+        if len(out):
+            self.emit(out)
+
+
+class WinSeq(_Pattern):
+    """Sequential window pattern (parallelism is always 1; farms build
+    parallelism around it, win_farm.hpp:134)."""
+
+    def __init__(self, winfunc, win_len: int, slide_len: int,
+                 win_type: WinType = WinType.CB, name="win_seq",
+                 incremental: bool = None, result_fields=None,
+                 config: PatternConfig = None, role: Role = Role.SEQ,
+                 map_indexes=(0, 1)):
+        super().__init__(name, parallelism=1)
+        self.spec = WindowSpec(win_len, slide_len, win_type)
+        # resolve the function flavour (meta_utils.hpp signature deduction
+        # becomes an explicit `incremental` switch)
+        if incremental is True:
+            winfunc = as_window_update(winfunc, result_fields)
+        elif incremental is False or isinstance(winfunc, WindowFunction):
+            winfunc = as_window_function(winfunc, result_fields)
+        elif isinstance(winfunc, WindowUpdate):
+            incremental = True
+        else:
+            winfunc = as_window_function(winfunc, result_fields)
+        self.winfunc = winfunc
+        self.incremental = bool(incremental)
+        self.config = config
+        self.role = role
+        self.map_indexes = map_indexes
+
+    def make_core(self) -> WinSeqCore:
+        core = WinSeqCore(self.spec, self.winfunc, config=self.config,
+                          role=self.role, map_indexes=self.map_indexes)
+        if self.incremental:
+            core.use_incremental()
+        return core
+
+    def _make_replica(self, i):
+        node = WinSeqNode(self.make_core(), f"{self.name}.{i}")
+        node.ctx = RuntimeContext(1, 0, self.name)
+        return node
+
+    @property
+    def result_schema(self):
+        from ..core.tuples import Schema
+        return Schema(**self.winfunc.result_fields)

@@ -1,0 +1,73 @@
+"""Routing nodes: the batch-native versions of the reference's L2 graph
+nodes (``standard.hpp``): pass-through / round-robin / keyed emitters and the
+trivial merging collector.
+
+Routing a batch means *splitting* it by destination with a vectorised
+predicate — the analog of per-tuple ``ff_send_out_to`` (standard.hpp:73-81)
+— so routing cost is O(batch), not O(tuple) dispatches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .node import Node
+
+
+def default_routing(keys: np.ndarray, n: int) -> np.ndarray:
+    """key -> destination in [0, n): the reference default is k % n
+    (builders.hpp:190)."""
+    return keys % n
+
+
+class StandardEmitter(Node):
+    """Pass-through (n=1), block round-robin, or keyed routing emitter
+    (standard.hpp:40-88)."""
+
+    def __init__(self, n_dest: int, routing=None, name="emitter"):
+        super().__init__(name)
+        self.n_dest = n_dest
+        self.routing = routing  # vectorised fn(keys, n) -> dest indices
+        self._rr = 0
+
+    def svc(self, batch, channel=0):
+        if self.n_dest == 1:
+            self.emit_to(0, batch)
+            return
+        if self.routing is None:
+            # round-robin whole chunks: preserves per-key order only within a
+            # replica, exactly like the reference's per-tuple round-robin
+            self.emit_to(self._rr, batch)
+            self._rr = (self._rr + 1) % self.n_dest
+            return
+        dest = np.asarray(self.routing(batch["key"], self.n_dest))
+        if len(batch) and (dest[0] == dest[-1]) and not np.any(dest != dest[0]):
+            self.emit_to(int(dest[0]), batch)
+            return
+        for d in range(self.n_dest):
+            sub = batch[dest == d]
+            if len(sub):
+                self.emit_to(d, sub)
+
+
+class Collector(Node):
+    """Trivial multi-in merge (standard.hpp:91-94)."""
+
+    def __init__(self, name="collector"):
+        super().__init__(name)
+
+    def svc(self, batch, channel=0):
+        self.emit(batch)
+
+
+class Broadcast(Node):
+    """Replicate every batch to all outputs — the zero-copy refcounted
+    multicast of the reference (multipipe.hpp:50-115) is free here because
+    numpy batches are immutable-by-convention views."""
+
+    def __init__(self, name="broadcast"):
+        super().__init__(name)
+
+    def svc(self, batch, channel=0):
+        for out in range(self.n_outputs):
+            self.emit_to(out, batch)

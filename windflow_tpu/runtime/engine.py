@@ -1,0 +1,119 @@
+"""Threaded dataflow engine — the runtime replacing FastFlow's pipeline of
+pinned threads + lock-free SPSC queues (SURVEY.md §2.8).
+
+Host-side dataflow stays on CPU threads exactly like the reference; the
+difference is that channel payloads are whole batches, so queue traffic is
+O(stream/chunk) instead of O(stream), and the Python GIL is released inside
+the numpy/XLA kernels doing the real work.  When the native C++ substrate is
+built (native/), Inbox transparently switches to the lock-free MPSC ring.
+
+Topology model: a directed graph of Nodes. Each node owns one Inbox; an edge
+(a -> b) reserves a source-slot in b's inbox so b can count per-channel EOS
+(the FastFlow multi-in protocol) and ordering nodes can tell channels apart.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from .node import Node, RuntimeContext, SourceNode
+
+_EOS = object()
+
+
+class Inbox:
+    """MPSC channel carrying (src_slot, batch) pairs."""
+
+    def __init__(self, capacity: int = 0):
+        self._q = queue.Queue(maxsize=capacity)
+        self.n_sources = 0
+
+    def register_source(self) -> int:
+        slot = self.n_sources
+        self.n_sources += 1
+        return slot
+
+    def put(self, src: int, item):
+        self._q.put((src, item))
+
+    def put_eos(self, src: int):
+        self._q.put((src, _EOS))
+
+    def get(self):
+        return self._q.get()
+
+
+class Dataflow:
+    """A graph of nodes executed by one thread per node
+    (MultiPipe::run_and_wait_end spawns cardinality()-1 threads,
+    multipipe.hpp:1010; same model here)."""
+
+    def __init__(self, name: str = "dataflow"):
+        self.name = name
+        self.nodes: list[Node] = []
+        self._inboxes: dict[int, Inbox] = {}
+        self._edges: list[tuple[Node, Node]] = []
+        self._threads: list[threading.Thread] = []
+        self._errors: list[BaseException] = []
+
+    def add(self, node: Node, ctx: RuntimeContext = None) -> Node:
+        if ctx is not None:
+            node.ctx = ctx
+        self.nodes.append(node)
+        self._inboxes[id(node)] = Inbox()
+        return node
+
+    def connect(self, src: Node, dst: Node):
+        """Add an edge; the order of connect() calls from one src defines its
+        output-channel indexing (emit_to)."""
+        inbox = self._inboxes[id(dst)]
+        slot = inbox.register_source()
+        src._outputs.append((inbox, slot))
+        self._edges.append((src, dst))
+
+    # ------------------------------------------------------------------ run
+
+    def _run_node(self, node: Node):
+        try:
+            node.svc_init()
+            if isinstance(node, SourceNode):
+                node.generate()
+            else:
+                inbox = self._inboxes[id(node)]
+                live = inbox.n_sources
+                while live > 0:
+                    src, item = inbox.get()
+                    if item is _EOS:
+                        live -= 1
+                        node.on_channel_eos(src)
+                    else:
+                        node.svc(item, src)
+            node.eosnotify()
+            node.svc_end()
+        except BaseException as e:  # propagate to run_and_wait_end
+            self._errors.append(e)
+        finally:
+            for inbox, src in node._outputs:
+                inbox.put_eos(src)
+
+    def run(self):
+        for node in self.nodes:
+            t = threading.Thread(target=self._run_node, args=(node,),
+                                 name=f"{self.name}/{node.name}", daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def wait(self):
+        for t in self._threads:
+            t.join()
+        if self._errors:
+            raise self._errors[0]
+
+    def run_and_wait_end(self):
+        self.run()
+        self.wait()
+
+    def cardinality(self) -> int:
+        """Number of execution threads (multipipe.hpp:973)."""
+        return len(self.nodes)

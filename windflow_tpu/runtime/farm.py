@@ -1,0 +1,70 @@
+"""Wiring helpers: build farm shells (emitter -> replicas -> collector) into
+a Dataflow graph — the structural equivalent of the reference's
+``ff_farm(emitter, workers, collector)`` containers (map.hpp:196-209) and of
+pipeline composition.  MultiPipe (api/) layers the fluent construction on
+top of these primitives.
+"""
+
+from __future__ import annotations
+
+from .engine import Dataflow
+from .node import Node
+
+#: sentinel: "use the pattern's default shell node"; pass None to fuse it away
+DEFAULT = object()
+
+
+def add_farm(df: Dataflow, pattern, upstreams: list[Node],
+             emitter: Node = DEFAULT, collector: Node = DEFAULT) -> list[Node]:
+    """Instantiate `pattern` as emitter -> replicas -> collector, feeding it
+    from `upstreams`.  Pass emitter/collector = None to fuse the shell node
+    away (the LEVEL1 `ff_comb` analog, pane_farm.hpp:435).  Pass-through
+    shells at parallelism 1 are skipped automatically.  Returns the nodes
+    downstream should connect from."""
+    replicas = pattern.replicas()
+    for r in replicas:
+        df.add(r)
+    if emitter is DEFAULT:
+        emitter = pattern.emitter()
+        # a 1-replica unrouted farm needs no emitter thread: the engine's
+        # multi-in inboxes merge upstreams at the replica directly
+        if (emitter is not None and type(emitter).__name__ == "StandardEmitter"
+                and pattern.parallelism == 1):
+            emitter = None
+    if collector is DEFAULT:
+        collector = pattern.collector()
+        if (collector is not None and type(collector).__name__ == "Collector"
+                and pattern.parallelism == 1):
+            collector = None
+    if emitter is not None:
+        df.add(emitter)
+        for up in upstreams:
+            df.connect(up, emitter)
+        for r in replicas:
+            df.connect(emitter, r)
+    elif upstreams:
+        # fused emitter: wire upstreams straight to replicas
+        if len(replicas) == 1:
+            for up in upstreams:
+                df.connect(up, replicas[0])
+        elif len(upstreams) == len(replicas):
+            for up, r in zip(upstreams, replicas):
+                df.connect(up, r)
+        else:
+            raise ValueError(
+                f"cannot fuse emitter: {len(upstreams)} upstreams vs "
+                f"{len(replicas)} replicas (all-to-all would duplicate data)")
+    if collector is not None:
+        df.add(collector)
+        for r in replicas:
+            df.connect(r, collector)
+        return [collector]
+    return replicas
+
+
+def build_pipeline(df: Dataflow, patterns: list) -> list[Node]:
+    """Chain patterns into a linear pipeline; returns the tail nodes."""
+    tails: list[Node] = []
+    for p in patterns:
+        tails = add_farm(df, p, tails)
+    return tails

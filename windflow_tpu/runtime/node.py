@@ -1,0 +1,102 @@
+"""Dataflow node contract — the runtime substrate's equivalent of FastFlow's
+``ff_node_t`` (``svc_init/svc/svc_end/eosnotify``, see reference usage at
+win_seq.hpp:256,268,433,477).
+
+Differences from the reference, by design:
+
+* the unit of exchange is a *batch* (structured numpy array), not a tuple
+  pointer — tuple-at-a-time is the degenerate batch of one;
+* nodes are wired by an :class:`~windflow_tpu.runtime.engine.Dataflow` graph
+  and run by worker threads; emission goes through :meth:`Node.emit` /
+  :meth:`Node.emit_to` (the ``ff_send_out`` / ``ff_send_out_to`` analogs,
+  standard.hpp:79);
+* EOS is per-input-channel, counted by the runner; when every input channel
+  has delivered EOS the node gets a final :meth:`eosnotify` to flush state,
+  then EOS propagates downstream.
+"""
+
+from __future__ import annotations
+
+
+class RuntimeContext:
+    """Execution context handed to "rich" user functions
+    (reference context.hpp:45-80): the replica's parallelism degree and
+    index within its pattern."""
+
+    __slots__ = ("parallelism", "index", "name")
+
+    def __init__(self, parallelism: int = 1, index: int = 0, name: str = ""):
+        self.parallelism = parallelism
+        self.index = index
+        self.name = name
+
+    def getParallelism(self) -> int:
+        return self.parallelism
+
+    def getReplicaIndex(self) -> int:
+        return self.index
+
+
+class Node:
+    """Base dataflow node. Subclasses override `svc` (and optionally the
+    lifecycle hooks). During execution `self._outputs` holds the output
+    channels and `self.ctx` the RuntimeContext."""
+
+    def __init__(self, name: str = None):
+        self.name = name or type(self).__name__
+        self._outputs = []   # list of (inbox, src_index) set by the graph
+        self.ctx = RuntimeContext()
+        # per-node service-time counters (the LOG_DIR equivalent; see
+        # utils/tracing.py). Filled by the runner when tracing is enabled.
+        self.stats = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def svc_init(self):
+        """Called once in the node's thread before any input."""
+
+    def svc(self, batch, channel: int = 0):
+        """Process one input batch from input `channel`."""
+        raise NotImplementedError
+
+    def on_channel_eos(self, channel: int):
+        """Called when one input channel reaches EOS (eosnotify(id))."""
+
+    def eosnotify(self):
+        """Called once after ALL input channels reached EOS; flush here."""
+
+    def svc_end(self):
+        """Called after eosnotify, before the thread exits."""
+
+    # -- emission ----------------------------------------------------------
+    def emit(self, batch):
+        """Send to every output channel (broadcast for 1 output; nodes with
+        several outputs that need routing use emit_to)."""
+        if batch is None:
+            return
+        for inbox, src in self._outputs:
+            inbox.put(src, batch)
+
+    def emit_to(self, out: int, batch):
+        """Send to one specific output channel (ff_send_out_to)."""
+        if batch is None:
+            return
+        inbox, src = self._outputs[out]
+        inbox.put(src, batch)
+
+    @property
+    def n_outputs(self) -> int:
+        return len(self._outputs)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SourceNode(Node):
+    """A node with no inputs: `generate` drives emission."""
+
+    def generate(self):
+        """Produce the stream by calling emit(); return to signal EOS."""
+        raise NotImplementedError
+
+    def svc(self, batch, channel=0):  # pragma: no cover
+        raise RuntimeError("source nodes receive no input")
