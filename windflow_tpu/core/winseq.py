@@ -65,8 +65,17 @@ class WinSeqCore:
     """Role-aware sequential window engine over one keyed stream partition."""
 
     def __init__(self, spec: WindowSpec, winfunc, config: PatternConfig = None,
-                 role: Role = Role.SEQ, map_indexes=(0, 1)):
+                 role: Role = Role.SEQ, map_indexes=(0, 1),
+                 result_ts_slide: int = None):
         self.spec = spec
+        # TB result ts uses the *global* slide of the logical window, which
+        # differs from spec.slide_len inside a farm worker (private slide =
+        # slide*pardegree). The reference quirkily uses the private slide
+        # (window.hpp:124 with win_farm.hpp:134's slide), making farm output
+        # ts diverge from Win_Seq's on the same stream; we normalise to the
+        # sequential semantics so all compositions agree.
+        self.result_ts_slide = (result_ts_slide if result_ts_slide is not None
+                                else spec.slide_len)
         self.config = config or PatternConfig.plain(spec.slide_len)
         self.role = role
         self.map_indexes = map_indexes
@@ -124,19 +133,20 @@ class WinSeqCore:
         """CB: ts of the last CONTINUE row per window; TB: closed form
         (window.hpp:121-124,154)."""
         if self.spec.win_type is WinType.TB:
-            return gwids * self.spec.slide_len + self.spec.win_len - 1
+            return gwids * self.result_ts_slide + self.spec.win_len - 1
         ends_abs = self.spec.win_end(lwids) + st.initial_id
         starts_abs = self.spec.win_start(lwids) + st.initial_id
         out = np.zeros(len(lwids), dtype=np.int64)
         if self.is_nic:
             p = st.archive.positions
             ts = st.archive.rows["ts"]
-            idx = np.searchsorted(p, ends_abs, side="left") - 1
-            # only rows inside [start, end) ever raised CONTINUE on this
-            # window (rows archived before the window was created must not
-            # contribute a timestamp; empty windows keep ts=0)
-            valid = (idx >= 0) & (p[np.maximum(idx, 0)] >= starts_abs)
-            out[valid] = ts[idx[valid]]
+            if len(p):
+                idx = np.searchsorted(p, ends_abs, side="left") - 1
+                # only rows inside [start, end) ever raised CONTINUE on this
+                # window (rows archived before the window was created must
+                # not contribute a timestamp; empty windows keep ts=0)
+                valid = (idx >= 0) & (p[np.maximum(idx, 0)] >= starts_abs)
+                out[valid] = ts[idx[valid]]
         else:
             for i, lw in enumerate(lwids):
                 if int(lw) in st.inc_last_ts:

@@ -1,0 +1,211 @@
+"""Win_Farm: window parallelism — windows are assigned round-robin to
+workers, each worker running the sequential core with a private slide of
+``slide * pardegree`` (reference win_farm.hpp:134-143).
+
+The emitter multicasts each tuple to exactly the workers whose windows
+contain it (wf_nodes.hpp:90-174); in the reference this uses a refcounted
+shared wrapper to avoid copies — here batches are immutable arrays, so the
+per-worker "copy" is a numpy boolean take of the batch (and the device-side
+analog goes further: the archive slice is staged once, see ops/device).
+
+At EOS the emitter replays each key's last tuple to ALL workers as an EOS
+marker (wf_nodes.hpp:177-191) so every worker opens/fires the same trailing
+windows Win_Seq would have.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.windows import PatternConfig, Role, WindowSpec, WinType
+from ..runtime.emitters import Collector, KeyedStreamState
+from ..runtime.node import Node, RuntimeContext
+from ..runtime.ordering import OrderingCore, OrderingMode
+from .basic import _Pattern
+from .win_seq import WinSeq, WinSeqNode
+
+_NEG_INF = np.int64(-(2 ** 62))
+
+
+class WFEmitterNode(Node):
+    """Window-range multicast emitter (wf_nodes.hpp:40-195)."""
+
+    def __init__(self, spec: WindowSpec, pardegree: int, id_outer=0, n_outer=1,
+                 slide_outer=None, role: Role = Role.SEQ, name="wf_emitter"):
+        super().__init__(name)
+        self.spec = spec
+        self.pardegree = pardegree
+        self.id_outer = id_outer
+        self.n_outer = n_outer
+        self.slide_outer = spec.slide_len if slide_outer is None else slide_outer
+        self.role = role
+        self.pos_field = "id" if spec.win_type is WinType.CB else "ts"
+        self._state = KeyedStreamState(self.pos_field)
+
+    def _initial_id(self, keys: np.ndarray) -> np.ndarray:
+        first_gwid = (self.id_outer - (keys % self.n_outer) + self.n_outer) % self.n_outer
+        init = first_gwid * self.slide_outer
+        if self.role in (Role.WLQ, Role.REDUCE):
+            init = np.zeros_like(init)
+        return init
+
+    def svc(self, batch, channel=0):
+        spec = self.spec
+        # marker absorption + out-of-order drop (wf_nodes.hpp:104-121)
+        batch = self._state.filter(batch)
+        if len(batch) == 0:
+            return
+        pos = batch[self.pos_field].astype(np.int64)
+        keys = batch["key"]
+        init = self._initial_id(keys)
+        rel = pos - init
+        keep = rel >= 0
+        if spec.is_hopping:
+            keep &= spec.in_any_window(np.maximum(rel, 0))
+        if not np.all(keep):
+            batch = batch[keep]
+            rel = rel[keep]
+            keys = keys[keep]
+        if len(batch) == 0:
+            return
+        # window range per row (wf_nodes.hpp:134-157)
+        first_w = spec.first_win_containing(rel)
+        last_w = spec.last_win_containing(rel)
+        count = last_w - first_w + 1
+        start_dst = keys % self.pardegree
+        n = self.pardegree
+        for d in range(n):
+            # worker d gets the row iff some w in [first, first+min(count,n))
+            # satisfies (key%n + w) % n == d
+            r = (d - start_dst - first_w) % n
+            m = (count >= n) | (r < count)
+            sub = batch[m]
+            if len(sub):
+                self.emit_to(d, sub)
+
+    def eosnotify(self):
+        # per-key EOS markers to every worker (wf_nodes.hpp:177-191)
+        markers = self._state.marker_batch()
+        if markers is None:
+            return
+        for d in range(self.pardegree):
+            self.emit_to(d, markers)
+
+
+class WFCollectorNode(Node):
+    """Ordered collector: per-key reorder over dense result ids
+    (wf_nodes.hpp:401-468), batch-native — pending rows are kept as column
+    chunks and the releasable contiguous id-run is found vectorised."""
+
+    def __init__(self, name="wf_collector"):
+        super().__init__(name)
+        self._keys = {}  # key -> [next_win, list-of-pending-chunks]
+
+    def svc(self, batch, channel=0):
+        outs = []
+        keys = batch["key"]
+        order = np.argsort(keys, kind="stable")
+        sk = keys[order]
+        bounds = np.flatnonzero(np.diff(sk)) + 1
+        for grp in np.split(order, bounds):
+            key = int(keys[grp[0]])
+            st = self._keys.get(key)
+            if st is None:
+                st = self._keys[key] = [0, []]
+            st[1].append(batch[grp])
+            pend = st[1][0] if len(st[1]) == 1 else np.concatenate(st[1])
+            ids = pend["id"]
+            o = np.argsort(ids, kind="stable")
+            sorted_ids = ids[o]
+            # longest contiguous run next, next+1, ... (ids are dense/unique)
+            run = sorted_ids == st[0] + np.arange(len(sorted_ids))
+            k = len(run) if run.all() else int(np.argmin(run))
+            if k:
+                outs.append(pend[o[:k]])
+                st[0] += k
+                st[1] = [pend[o[k:]]] if k < len(pend) else []
+            else:
+                st[1] = [pend]
+        for o in outs:
+            self.emit(o)
+
+
+class _OrderedWorkerNode(WinSeqNode):
+    """OrderingCore fused in front of a window core — the
+    ff_comb(OrderingNode, Win_Seq) worker used behind multiple emitters
+    (win_farm.hpp:157-162)."""
+
+    def __init__(self, core, n_channels, mode, name):
+        super().__init__(core, name)
+        self.ordering = OrderingCore(n_channels, mode)
+
+    def svc_init(self):
+        if self.n_input_channels != self.ordering.n_channels:
+            raise RuntimeError(
+                f"{self.name}: wired with {self.n_input_channels} input "
+                f"channels but ordering expects {self.ordering.n_channels} "
+                "(n_emitters mismatch — results would buffer until EOS)")
+
+    def svc(self, batch, channel=0):
+        for merged in self.ordering.push(batch, channel):
+            super().svc(merged)
+
+    def eosnotify(self):
+        for merged in self.ordering.flush():
+            WinSeqNode.svc(self, merged)
+        super().eosnotify()
+
+
+class WinFarm(_Pattern):
+    """Window-parallel farm of sequential cores (win_farm.hpp)."""
+
+    def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
+                 pardegree=2, name="win_farm", incremental=None,
+                 result_fields=None, ordered=True, n_emitters=1,
+                 config: PatternConfig = None, role: Role = Role.SEQ):
+        super().__init__(name, pardegree)
+        self.spec = WindowSpec(win_len, slide_len, win_type)
+        self.ordered = ordered
+        self.n_emitters = n_emitters
+        self.config = config or PatternConfig.plain(slide_len)
+        self.role = role
+        # worker template: private slide, nested PatternConfig
+        # (win_farm.hpp:134-143)
+        self._workers = []
+        for i in range(pardegree):
+            cfg = PatternConfig(
+                id_outer=self.config.id_inner, n_outer=self.config.n_inner,
+                slide_outer=self.config.slide_inner,
+                id_inner=i, n_inner=pardegree, slide_inner=slide_len)
+            self._workers.append(WinSeq(
+                winfunc, win_len, slide_len * pardegree, win_type,
+                name=f"{name}_wf.{i}", incremental=incremental,
+                result_fields=result_fields, config=cfg, role=role,
+                result_ts_slide=slide_len))
+
+    @property
+    def result_schema(self):
+        return self._workers[0].result_schema
+
+    def emitter(self):
+        return WFEmitterNode(self.spec, self.parallelism,
+                             id_outer=self.config.id_inner,
+                             n_outer=self.config.n_inner,
+                             slide_outer=self.config.slide_inner,
+                             role=self.role, name=f"{self.name}.emitter")
+
+    def collector(self):
+        if self.ordered:
+            return WFCollectorNode(name=f"{self.name}.collector")
+        return Collector(name=f"{self.name}.collector")
+
+    def _make_replica(self, i):
+        w = self._workers[i]
+        if self.n_emitters > 1:
+            mode = OrderingMode.ID if self.spec.win_type is WinType.CB else OrderingMode.TS
+            node = _OrderedWorkerNode(w.make_core(), self.n_emitters, mode,
+                                      f"{self.name}.{i}")
+        else:
+            node = WinSeqNode(w.make_core(), f"{self.name}.{i}")
+        node.ctx = RuntimeContext(self.parallelism, i, self.name)
+        return node

@@ -1,0 +1,163 @@
+"""Win_MapReduce: window-partition parallelism — each window's tuples are
+split round-robin across MAP workers computing partial results, merged per
+window by a REDUCE stage (reference win_mapreduce.hpp, wm_nodes.hpp).
+
+* MAP: ``map_degree`` sequential cores with the SAME win/slide, role MAP,
+  ``map_indexes=(i, n)`` — worker i's k-th result gets the dense id
+  ``i + k*n`` (win_seq.hpp:397-399), so the merged per-key MAP output ids
+  are 0,1,2,... with n consecutive ids = the n partials of one window.
+* The emitter assigns tuples per key round-robin starting at
+  ``key % map_degree`` (wm_nodes.hpp:101-110) and broadcasts each key's last
+  tuple to all workers as an EOS marker (wm_nodes.hpp:115-129).
+* A reorder collector restores dense-id order per key (wm_nodes.hpp:218).
+* REDUCE: a CB window of len = slide = ``map_degree`` over the partial
+  stream, role REDUCE (win_mapreduce.hpp:173-183) — one firing = one
+  window's n partials combined.
+
+This is the streaming analog of tensor parallelism over one long window —
+the TPU mesh version computes the partials per core and the REDUCE merge as
+an on-device tree reduction over ICI (parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.windows import PatternConfig, Role, WindowSpec, WinType
+from ..runtime.emitters import KeyedStreamState
+from ..runtime.node import Node, RuntimeContext
+from .basic import _Pattern
+from .win_farm import WFCollectorNode, WinFarm
+from .win_seq import WinSeq, WinSeqNode
+
+_NEG_INF = np.int64(-(2 ** 62))
+
+
+class WinMapEmitterNode(Node):
+    """Per-key round-robin partitioner (wm_nodes.hpp:40-133)."""
+
+    def __init__(self, map_degree: int, win_type: WinType, name="wm_emitter"):
+        super().__init__(name)
+        self.map_degree = map_degree
+        self.pos_field = "id" if win_type is WinType.CB else "ts"
+        self._state = KeyedStreamState(self.pos_field)
+        self._next_dst = {}  # key -> next round-robin destination
+
+    def svc(self, batch, channel=0):
+        n = self.map_degree
+        # marker absorption + ooo drop shared with WF emitter
+        # (wm_nodes.hpp:87-104 mirrors wf_nodes.hpp:104-121)
+        batch = self._state.filter(batch)
+        if len(batch) == 0:
+            return
+        keys = batch["key"]
+        dest = np.empty(len(batch), dtype=np.int64)
+        for k in np.unique(keys):
+            idx = np.flatnonzero(keys == k)
+            nxt = self._next_dst.get(int(k), int(k) % n)
+            dest[idx] = (nxt + np.arange(len(idx))) % n
+            self._next_dst[int(k)] = (nxt + len(idx)) % n
+        for d in range(n):
+            sub = batch[dest == d]
+            if len(sub):
+                self.emit_to(d, sub)
+
+    def eosnotify(self):
+        markers = self._state.marker_batch()
+        if markers is None:
+            return
+        for d in range(self.map_degree):
+            self.emit_to(d, markers)
+
+
+class _MapStage(_Pattern):
+    """The MAP farm: per-replica map_indexes, round-robin emitter, dense-id
+    reorder collector (win_mapreduce.hpp:147-163)."""
+
+    def __init__(self, map_func, spec: WindowSpec, map_degree, name,
+                 incremental, result_fields, config: PatternConfig):
+        super().__init__(name, map_degree)
+        cfg = PatternConfig(config.id_inner, config.n_inner, config.slide_inner,
+                            0, 1, spec.slide_len)
+        self._workers = [
+            WinSeq(map_func, spec.win_len, spec.slide_len, spec.win_type,
+                   name=f"{name}.{i}", incremental=incremental,
+                   result_fields=result_fields, config=cfg, role=Role.MAP,
+                   map_indexes=(i, map_degree))
+            for i in range(map_degree)]
+        self.spec = spec
+
+    @property
+    def result_schema(self):
+        return self._workers[0].result_schema
+
+    def emitter(self):
+        return WinMapEmitterNode(self.parallelism, self.spec.win_type,
+                                 name=f"{self.name}.emitter")
+
+    def collector(self):
+        return WFCollectorNode(name=f"{self.name}.collector")
+
+    def _make_replica(self, i):
+        node = WinSeqNode(self._workers[i].make_core(), f"{self.name}.{i}")
+        node.ctx = RuntimeContext(self.parallelism, i, self.name)
+        return node
+
+
+class WinMapReduce:
+    """Composite two-stage pattern (MAP farm + REDUCE)."""
+
+    def __init__(self, map_func, reduce_func, win_len, slide_len,
+                 win_type=WinType.CB, map_degree=2, reduce_degree=1,
+                 name="win_mr", map_incremental=None, reduce_incremental=None,
+                 map_result_fields=None, reduce_result_fields=None,
+                 ordered=True, config: PatternConfig = None):
+        if map_degree < 2:
+            raise ValueError("Win_MapReduce needs a parallel MAP stage "
+                             "(win_mapreduce.hpp:135)")
+        self._proto = dict(
+            map_func=map_func, reduce_func=reduce_func, win_len=win_len,
+            slide_len=slide_len, win_type=win_type, map_degree=map_degree,
+            reduce_degree=reduce_degree, map_incremental=map_incremental,
+            reduce_incremental=reduce_incremental,
+            map_result_fields=map_result_fields,
+            reduce_result_fields=reduce_result_fields)
+        self.spec = WindowSpec(win_len, slide_len, win_type)
+        self.name = name
+        self.config = config or PatternConfig.plain(slide_len)
+        cfg = self.config
+        n = map_degree
+        self.map_stage = _MapStage(map_func, self.spec, n, f"{name}_map",
+                                   map_incremental, map_result_fields, cfg)
+        # REDUCE: CB window n/n over the dense partial stream
+        # (win_mapreduce.hpp:173-183)
+        if reduce_degree > 1:
+            self.reduce_stage = WinFarm(
+                reduce_func, n, n, WinType.CB, pardegree=reduce_degree,
+                name=f"{name}_reduce", incremental=reduce_incremental,
+                result_fields=reduce_result_fields, ordered=ordered,
+                config=cfg, role=Role.REDUCE)
+        else:
+            red_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
+                                    0, 1, n)
+            self.reduce_stage = WinSeq(
+                reduce_func, n, n, WinType.CB, name=f"{name}_reduce",
+                incremental=reduce_incremental,
+                result_fields=reduce_result_fields, config=red_cfg,
+                role=Role.REDUCE)
+
+    @property
+    def result_schema(self):
+        return self.reduce_stage.result_schema
+
+    def instantiate(self, df, upstreams):
+        from ..runtime.farm import add_farm
+        tails = add_farm(df, self.map_stage, upstreams)
+        return add_farm(df, self.reduce_stage, tails)
+
+    def clone_with(self, name, slide_len=None, config=None, ordered=False):
+        """Replicate as a nested-farm worker (win_farm.hpp ctor IV)."""
+        kw = dict(self._proto)
+        if slide_len is not None:
+            kw["slide_len"] = slide_len
+        return WinMapReduce(name=name, config=config, ordered=ordered, **kw)

@@ -37,9 +37,10 @@ class WinSeq(_Pattern):
                  win_type: WinType = WinType.CB, name="win_seq",
                  incremental: bool = None, result_fields=None,
                  config: PatternConfig = None, role: Role = Role.SEQ,
-                 map_indexes=(0, 1)):
+                 map_indexes=(0, 1), result_ts_slide: int = None):
         super().__init__(name, parallelism=1)
         self.spec = WindowSpec(win_len, slide_len, win_type)
+        self.result_ts_slide = result_ts_slide
         # resolve the function flavour (meta_utils.hpp signature deduction
         # becomes an explicit `incremental` switch)
         if incremental is True:
@@ -58,7 +59,8 @@ class WinSeq(_Pattern):
 
     def make_core(self) -> WinSeqCore:
         core = WinSeqCore(self.spec, self.winfunc, config=self.config,
-                          role=self.role, map_indexes=self.map_indexes)
+                          role=self.role, map_indexes=self.map_indexes,
+                          result_ts_slide=self.result_ts_slide)
         if self.incremental:
             core.use_incremental()
         return core

@@ -11,7 +11,63 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core.tuples import MARKER_FIELD
 from .node import Node
+
+_NEG_INF = np.int64(-(2 ** 62))
+
+
+class KeyedStreamState:
+    """Per-key last-tuple bookkeeping shared by window emitters: the
+    out-of-order drop and the EOS-marker source (wf_nodes.hpp:60-121,
+    wm_nodes.hpp:52-104).  Also absorbs markers arriving from an enclosing
+    nesting emitter so this emitter's own markers carry the key's global
+    last tuple."""
+
+    __slots__ = ("pos_field", "last")
+
+    def __init__(self, pos_field: str):
+        self.pos_field = pos_field
+        self.last = {}  # key -> (last_pos, last_row_copy)
+
+    def filter(self, batch: np.ndarray) -> np.ndarray:
+        """Absorb marker rows and drop out-of-order rows; returns the
+        surviving (real) rows, arrival order preserved."""
+        mk = batch[MARKER_FIELD]
+        if np.any(mk):
+            for row in batch[mk]:
+                k = int(row["key"])
+                p = int(row[self.pos_field])
+                prev = self.last.get(k)
+                if prev is None or p >= prev[0]:
+                    self.last[k] = (p, row.copy())
+            batch = batch[~mk]
+        if len(batch) == 0:
+            return batch
+        keys = batch["key"]
+        pos = batch[self.pos_field].astype(np.int64)
+        keep = np.ones(len(batch), dtype=bool)
+        for k in np.unique(keys):
+            m = keys == k
+            p = pos[m]
+            prev = self.last.get(int(k))
+            lastpos = prev[0] if prev else _NEG_INF
+            runmax = np.maximum.accumulate(np.concatenate(([lastpos], p)))[:-1]
+            ok = p >= runmax
+            keep[m] = ok
+            if ok.any():
+                sel = np.flatnonzero(m)[np.flatnonzero(ok)[-1]]
+                self.last[int(k)] = (int(p[ok][-1]), batch[sel].copy())
+        return batch if keep.all() else batch[keep]
+
+    def marker_batch(self) -> np.ndarray | None:
+        """One marker row per key (its last tuple), for EOS replay."""
+        rows = [row for _, row in self.last.values() if row is not None]
+        if not rows:
+            return None
+        markers = np.stack(rows)
+        markers[MARKER_FIELD] = True
+        return markers
 
 
 def default_routing(keys: np.ndarray, n: int) -> np.ndarray:

@@ -76,6 +76,7 @@ class Dataflow:
 
     def _run_node(self, node: Node):
         try:
+            node.n_input_channels = self._inboxes[id(node)].n_sources
             node.svc_init()
             if isinstance(node, SourceNode):
                 node.generate()

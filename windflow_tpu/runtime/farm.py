@@ -21,6 +21,15 @@ def add_farm(df: Dataflow, pattern, upstreams: list[Node],
     away (the LEVEL1 `ff_comb` analog, pane_farm.hpp:435).  Pass-through
     shells at parallelism 1 are skipped automatically.  Returns the nodes
     downstream should connect from."""
+    if hasattr(pattern, "instantiate"):
+        # composite pattern (a pipeline of farms, e.g. Pane_Farm): it wires
+        # its own stages (reference: Pane_Farm is an ff_pipeline of two
+        # Win_Seq/Win_Farm stages, pane_farm.hpp:149-181)
+        if emitter is not DEFAULT or collector is not DEFAULT:
+            raise ValueError(
+                "emitter/collector overrides do not apply to composite "
+                f"patterns ({type(pattern).__name__} wires its own stages)")
+        return pattern.instantiate(df, upstreams)
     replicas = pattern.replicas()
     for r in replicas:
         df.add(r)

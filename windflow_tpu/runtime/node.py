@@ -45,6 +45,7 @@ class Node:
     def __init__(self, name: str = None):
         self.name = name or type(self).__name__
         self._outputs = []   # list of (inbox, src_index) set by the graph
+        self.n_input_channels = 0  # set by the engine before svc_init
         self.ctx = RuntimeContext()
         # per-node service-time counters (the LOG_DIR equivalent; see
         # utils/tracing.py). Filled by the runner when tracing is enabled.

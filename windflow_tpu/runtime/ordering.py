@@ -1,0 +1,153 @@
+"""Ordering nodes: k-way merge of per-channel ordered streams with per-key
+watermarks — the reference ``OrderingNode`` (orderingNode.hpp:49-225).
+
+Semantics reproduced exactly:
+
+* per key, ``maxs[c]`` tracks the greatest position seen from channel ``c``;
+  buffered rows are released once their position is <= min(maxs)
+  (orderingNode.hpp:151-162);
+* EOS *markers* are set aside (keeping the max-position one per key) and
+  re-emitted last at EOS, after the residual buffer flush
+  (orderingNode.hpp:134-147, 188-220);
+* ``TS_RENUMBERING`` rewrites ids with a dense per-key counter after the
+  time-ordered merge (orderingNode.hpp:167-172) — this is what lets
+  count-windows sit behind a broadcast in MultiPipe.
+
+Batch-native: rows are buffered per (key, channel) as column chunks and the
+releasable prefix is computed with numpy merges, so cost is O(rows log k)
+with tiny constants rather than a per-tuple priority queue.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+from ..core.tuples import MARKER_FIELD
+from .node import Node
+
+_NEG_INF = -(2 ** 62)
+
+
+class OrderingMode(enum.Enum):
+    ID = "id"                      # merge by tuple id
+    TS = "ts"                      # merge by timestamp
+    TS_RENUMBERING = "ts_renum"    # merge by ts, then renumber ids densely
+
+
+class _KeyBuf:
+    __slots__ = ("chans", "maxs", "marker_row", "marker_pos", "emit_counter")
+
+    def __init__(self, n_channels):
+        self.chans = [[] for _ in range(n_channels)]  # lists of row chunks
+        self.maxs = np.full(n_channels, 0, dtype=np.int64)
+        self.marker_row = None
+        self.marker_pos = _NEG_INF
+        self.emit_counter = 0
+
+
+class OrderingCore:
+    """Reusable merge engine (also fused in front of farm workers, the
+    ff_comb(OrderingNode, worker) analog, win_farm.hpp:157-162)."""
+
+    def __init__(self, n_channels: int, mode: OrderingMode):
+        self.n_channels = n_channels
+        self.mode = mode
+        self.pos_field = "id" if mode is OrderingMode.ID else "ts"
+        self._keys: dict[int, _KeyBuf] = {}
+
+    def _buf(self, key):
+        b = self._keys.get(key)
+        if b is None:
+            b = _KeyBuf(self.n_channels)
+            self._keys[key] = b
+        return b
+
+    def _release(self, kb: _KeyBuf, key: int, upto: int) -> np.ndarray | None:
+        """Pop every buffered row with pos <= upto, merged in pos order."""
+        take = []
+        for c, chunks in enumerate(kb.chans):
+            if not chunks:
+                continue
+            rows = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+            pos = rows[self.pos_field]
+            cut = int(np.searchsorted(pos, upto, side="right"))
+            if cut:
+                take.append(rows[:cut])
+                kb.chans[c] = [rows[cut:]] if cut < len(rows) else []
+            else:
+                kb.chans[c] = [rows]
+        if not take:
+            return None
+        merged = take[0] if len(take) == 1 else np.concatenate(take)
+        order = np.argsort(merged[self.pos_field], kind="stable")
+        merged = merged[order]
+        if self.mode is OrderingMode.TS_RENUMBERING:
+            merged = merged.copy()
+            merged["id"] = kb.emit_counter + np.arange(len(merged))
+            kb.emit_counter += len(merged)
+        return merged
+
+    def push(self, batch: np.ndarray, channel: int):
+        """Buffer one per-key-ordered batch from `channel`; yield releasable
+        merged chunks."""
+        out = []
+        marker = batch[MARKER_FIELD]
+        if np.any(marker):
+            for row in batch[marker]:
+                kb = self._buf(int(row["key"]))
+                p = int(row[self.pos_field])
+                if p > kb.marker_pos or kb.marker_row is None:
+                    kb.marker_pos = p
+                    kb.marker_row = row.copy()
+            batch = batch[~marker]
+        if len(batch) == 0:
+            return out
+        keys = batch["key"]
+        order = np.argsort(keys, kind="stable")
+        sk = keys[order]
+        bounds = np.flatnonzero(np.diff(sk)) + 1
+        for grp in np.split(order, bounds):
+            key = int(keys[grp[0]])
+            kb = self._buf(key)
+            rows = batch[grp]
+            kb.maxs[channel] = int(rows[self.pos_field][-1])
+            kb.chans[channel].append(rows)
+            rel = self._release(kb, key, int(kb.maxs.min()))
+            if rel is not None:
+                out.append(rel)
+        return out
+
+    def flush(self):
+        """EOS: release everything, then the per-key marker (renumbered too,
+        orderingNode.hpp:197-219)."""
+        out = []
+        for key, kb in self._keys.items():
+            rel = self._release(kb, key, 2 ** 62)
+            if rel is not None:
+                out.append(rel)
+            if kb.marker_row is not None:
+                m = kb.marker_row.copy().reshape(1)
+                if self.mode is OrderingMode.TS_RENUMBERING:
+                    m["id"] = kb.emit_counter
+                    kb.emit_counter += 1
+                out.append(m)
+                kb.marker_row = None
+        return out
+
+
+class OrderingNode(Node):
+    """Standalone ordering node (multi-in)."""
+
+    def __init__(self, n_channels: int, mode: OrderingMode, name="ordering"):
+        super().__init__(name)
+        self.core = OrderingCore(n_channels, mode)
+
+    def svc(self, batch, channel=0):
+        for out in self.core.push(batch, channel):
+            self.emit(out)
+
+    def eosnotify(self):
+        for out in self.core.flush():
+            self.emit(out)
