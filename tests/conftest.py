@@ -4,10 +4,18 @@ separately dry-runs the multi-chip path; bench.py runs on the real chip).
 Must run before jax is imported anywhere."""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, not setdefault: the outer environment may pin JAX_PLATFORMS to the
+# TPU plugin, and tests must run on the virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("WINDFLOW_TPU_HOST_ONLY", "0")
+
+# a TPU host's sitecustomize may pre-import jax before this conftest runs,
+# latching the platform choice — override through the config API as well
+if "jax" in sys.modules:
+    import jax
+    jax.config.update("jax_platforms", "cpu")

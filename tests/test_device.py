@@ -1,0 +1,191 @@
+"""Device-path differential tests — the equivalent of src/sum_test_gpu:
+every TPU pattern must produce the same results as its host counterpart /
+Win_Seq on the same stream.  Under pytest these run on the CPU XLA backend
+(conftest pins JAX_PLATFORMS=cpu); bench.py runs the same code on the real
+chip."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.patterns.win_seq import WinSeq
+from windflow_tpu.patterns.win_seq_tpu import (DeviceWinSeqCore,
+                                               JaxWindowFunction, KeyFarmTPU,
+                                               PaneFarmTPU, WinFarmTPU,
+                                               WinMapReduceTPU, WinSeqTPU)
+
+from test_farms import cb_stream_batches, tb_stream_batches, run_windowed
+from test_pane_wmr import iv
+
+
+def ref(win, slide, wt, batches):
+    return run_windowed(WinSeq(Reducer("sum"), win, slide, wt), batches)
+
+
+@pytest.mark.parametrize("win,slide", [(8, 3), (8, 8), (3, 8), (16, 7)])
+@pytest.mark.parametrize("batch_len", [1, 7, 64, 100000])
+def test_win_seq_tpu_cb(win, slide, batch_len):
+    keys, n = 3, 150
+    got = run_windowed(
+        WinSeqTPU(Reducer("sum"), win, slide, WinType.CB,
+                  batch_len=batch_len),
+        cb_stream_batches(keys, n))
+    assert got == ref(win, slide, WinType.CB, cb_stream_batches(keys, n))
+
+
+@pytest.mark.parametrize("win,slide", [(40, 15), (30, 30), (15, 40)])
+def test_win_seq_tpu_tb_ragged(win, slide):
+    """TB windows are ragged -> exercises bucket padding + masking."""
+    keys, n = 2, 160
+    got = run_windowed(
+        WinSeqTPU(Reducer("sum"), win, slide, WinType.TB, batch_len=32),
+        tb_stream_batches(keys, n))
+    assert got == ref(win, slide, WinType.TB, tb_stream_batches(keys, n))
+
+
+@pytest.mark.parametrize("op", ["sum", "count", "min", "max", "mean"])
+def test_builtin_ops_device(op):
+    if op == "mean":
+        pytest.skip("host Reducer has no mean; covered by jax-fn test")
+    got = run_windowed(
+        WinSeqTPU(Reducer(op), 10, 4, WinType.CB, batch_len=16),
+        cb_stream_batches(2, 100))
+    want = run_windowed(WinSeq(Reducer(op), 10, 4, WinType.CB),
+                        cb_stream_batches(2, 100))
+    assert got == want
+
+
+def test_count_without_value_field():
+    """count stages no payload columns at all (required_fields=())."""
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.patterns.basic import Sink, Source
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+
+    bare = Schema()  # no payload fields
+    ids = np.arange(40)
+    b = batch_from_columns(bare, key=np.zeros(40), id=ids, ts=ids)
+    got = []
+    df = Dataflow()
+    build_pipeline(df, [
+        Source(batches=[b], schema=bare),
+        WinSeqTPU(Reducer("count"), 10, 10, WinType.CB, batch_len=4),
+        Sink(lambda r: got.append(int(r["value"])) if r is not None else None)])
+    df.run_and_wait_end()
+    assert got == [10, 10, 10, 10]
+
+
+def test_user_jax_window_function():
+    """Arbitrary JAX function over the window batch — the CUDA-functor
+    replacement: here, sum of squares."""
+    def fn(keys, gwids, cols, mask):
+        v = cols["value"]
+        return jnp.sum(jnp.where(mask, v * v, 0), axis=1)
+
+    jf = JaxWindowFunction(fn, fields=("value",),
+                           result_fields={"value": np.int64})
+    got = run_windowed(WinSeqTPU(jf, 6, 2, WinType.CB, batch_len=32),
+                       cb_stream_batches(2, 80))
+
+    def host(key, gwid, rows):
+        return int(np.sum(rows["value"].astype(np.int64) ** 2))
+
+    from windflow_tpu.ops.functions import FnWindowFunction
+    want = run_windowed(
+        WinSeq(FnWindowFunction(host, {"value": np.int64}), 6, 2, WinType.CB),
+        cb_stream_batches(2, 80))
+    assert got == want
+
+
+def test_host_python_fn_rejected_on_device():
+    with pytest.raises(TypeError, match="cannot be staged"):
+        WinSeqTPU(lambda k, g, rows: 0, 4, 2, WinType.CB).make_core()
+
+
+def test_incremental_rejected_on_device():
+    core = WinSeqTPU(Reducer("sum"), 4, 2, WinType.CB).make_core()
+    with pytest.raises(TypeError, match="non-incremental"):
+        core.use_incremental()
+
+
+def test_pallas_windowed_reduce_interpret():
+    """The pallas kernel (interpret mode on CPU) against numpy."""
+    from windflow_tpu.ops.pallas_kernels import windowed_reduce_pallas
+
+    rng = np.random.default_rng(0)
+    flat = rng.integers(0, 100, size=256).astype(np.int32)
+    starts = np.arange(0, 128, 2, dtype=np.int32)   # 64 windows
+    lens = rng.integers(0, 17, size=64).astype(np.int32)
+    out = np.asarray(windowed_reduce_pallas(
+        np.concatenate([flat, np.zeros(32, np.int32)]), starts, lens, 32,
+        "sum", interpret=True))
+    want = np.array([flat[s:s + l].sum() for s, l in zip(starts, lens)],
+                    dtype=np.int32)
+    assert np.array_equal(out, want)
+
+
+def test_win_seq_tpu_pallas_matches():
+    got = run_windowed(
+        WinSeqTPU(Reducer("sum"), 12, 5, WinType.CB, batch_len=64,
+                  use_pallas=True),
+        cb_stream_batches(2, 200))
+    assert got == ref(12, 5, WinType.CB, cb_stream_batches(2, 200))
+
+
+@pytest.mark.parametrize("pardegree", [2, 3])
+def test_win_farm_tpu(pardegree):
+    keys, n = 3, 140
+    got = run_windowed(
+        WinFarmTPU(Reducer("sum"), 10, 4, WinType.CB, pardegree=pardegree,
+                   batch_len=16),
+        cb_stream_batches(keys, n))
+    assert got == ref(10, 4, WinType.CB, cb_stream_batches(keys, n))
+
+
+@pytest.mark.parametrize("pardegree", [2, 4])
+def test_key_farm_tpu(pardegree):
+    keys, n = 5, 120
+    got = run_windowed(
+        KeyFarmTPU(Reducer("sum"), 10, 4, WinType.CB, pardegree=pardegree,
+                   batch_len=16),
+        cb_stream_batches(keys, n))
+    assert got == ref(10, 4, WinType.CB, cb_stream_batches(keys, n))
+
+
+@pytest.mark.parametrize("plq_dev,wlq_dev", [(True, False), (False, True),
+                                             (True, True)])
+def test_pane_farm_tpu_stage_placement(plq_dev, wlq_dev):
+    keys, n = 3, 120
+    got = iv(run_windowed(
+        PaneFarmTPU(Reducer("sum"), Reducer("sum"), 12, 4, WinType.CB,
+                    plq_degree=2, wlq_degree=2, plq_on_device=plq_dev,
+                    wlq_on_device=wlq_dev, batch_len=16),
+        cb_stream_batches(keys, n)))
+    assert got == iv(ref(12, 4, WinType.CB, cb_stream_batches(keys, n)))
+
+
+@pytest.mark.parametrize("map_dev,red_dev", [(True, False), (False, True),
+                                             (True, True)])
+def test_win_mapreduce_tpu_stage_placement(map_dev, red_dev):
+    keys, n = 3, 120
+    got = iv(run_windowed(
+        WinMapReduceTPU(Reducer("sum"), Reducer("sum"), 12, 4, WinType.CB,
+                        map_degree=3, reduce_degree=2, map_on_device=map_dev,
+                        reduce_on_device=red_dev, batch_len=16),
+        cb_stream_batches(keys, n)))
+    assert got == iv(ref(12, 4, WinType.CB, cb_stream_batches(keys, n)))
+
+
+def test_nested_tpu_inner():
+    """Nesting with device inner patterns: WF(PF_TPU)."""
+    from windflow_tpu.patterns.nesting import WinFarmOf
+
+    keys, n = 3, 140
+    inner = PaneFarmTPU(Reducer("sum"), Reducer("sum"), 16, 4, WinType.CB,
+                        plq_degree=2, wlq_degree=1, batch_len=16)
+    got = iv(run_windowed(WinFarmOf(inner, pardegree=2),
+                          cb_stream_batches(keys, n)))
+    assert got == iv(ref(16, 4, WinType.CB, cb_stream_batches(keys, n)))

@@ -332,7 +332,9 @@ class WinSeqCore:
                 continue
             lwids = np.arange(st.n_fired, st.next_lwid, dtype=np.int64)
             st.n_fired = st.next_lwid
-            outs.append(self._emit_windows(key, st, lwids, eos=True))
+            r = self._emit_windows(key, st, lwids, eos=True)
+            if r is not None:  # device cores enqueue instead of returning
+                outs.append(r)
         if not outs:
             return np.zeros(0, dtype=self._result_dtype)
         return np.concatenate(outs)

@@ -1,0 +1,79 @@
+"""Pallas TPU kernels for windowed reductions.
+
+The XLA gather path (ops/device.py) materialises a (B, pad) tile in HBM
+before reducing; for large windows that tile dominates memory traffic.
+This kernel instead walks the *flat* staged buffer directly: each program
+dynamic-slices its windows out of VMEM and reduces on the VPU, so HBM
+traffic is O(flat + B) instead of O(B * pad) — the sliding-window overlap
+between consecutive windows is read from VMEM, not re-fetched from HBM.
+
+One program reduces a group of G windows (the analog of the reference's
+one-window-per-CUDA-thread kernel, win_seq_gpu.hpp:54-67, re-tiled for the
+8x128 VPU instead of 32-thread warps).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_GROUP = 8  # windows per program (one VPU sublane each)
+
+
+def _identity(op, dtype):
+    if op in ("sum", "count"):
+        return 0
+    if op == "prod":
+        return 1
+    info = (jnp.finfo if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.iinfo)(dtype)
+    return info.max if op == "min" else info.min
+
+
+_REDUCERS = {
+    "sum": jnp.sum, "min": jnp.min, "max": jnp.max, "prod": jnp.prod,
+}
+
+
+def _kernel(starts_ref, lens_ref, flat_ref, out_ref, *, pad, op, dtype):
+    i = pl.program_id(0)
+    ident = _identity(op, dtype)
+    lane = jax.lax.iota(jnp.int32, pad)
+    rows = []
+    for g in range(_GROUP):
+        w = i * _GROUP + g
+        s = starts_ref[w]
+        l = lens_ref[w]
+        vals = flat_ref[pl.ds(s, pad)]
+        if op == "count":
+            rows.append(l.astype(dtype))
+        else:
+            masked = jnp.where(lane < l, vals, ident)
+            rows.append(_REDUCERS[op](masked))
+    out_ref[pl.ds(i * _GROUP, _GROUP)] = jnp.stack(rows)
+
+
+@functools.partial(jax.jit, static_argnames=("pad", "op", "interpret"))
+def windowed_reduce_pallas(flat, starts, lens, pad, op, interpret=False):
+    """Reduce B windows (flat[starts[i] : starts[i]+lens[i]], lens <= pad)
+    with the monoid `op`; flat must be padded so every slice of `pad`
+    elements starting at any start is in bounds."""
+    B = starts.shape[0]
+    assert B % _GROUP == 0, "batch must be a multiple of the window group"
+    kernel = functools.partial(_kernel, pad=pad, op=op, dtype=flat.dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // _GROUP,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # starts
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # lens
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # flat buffer
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B,), flat.dtype),
+        interpret=interpret,
+    )(starts, lens, flat)

@@ -37,7 +37,12 @@ class KeyFarm(_Pattern):
         return StandardEmitter(self.parallelism, self.routing,
                                name=f"{self.name}.emitter")
 
+    def _make_core(self, worker):
+        """Core-factory hook: TPU farms override to build device cores."""
+        return worker.make_core()
+
     def _make_replica(self, i):
-        node = WinSeqNode(self._seq_template.make_core(), f"{self.name}.{i}")
+        node = WinSeqNode(self._make_core(self._seq_template),
+                          f"{self.name}.{i}")
         node.ctx = RuntimeContext(self.parallelism, i, self.name)
         return node

@@ -57,35 +57,33 @@ class PaneFarm:
         cfg = self.config
         pane = self.pane_len
         # --- PLQ stage: tumbling panes, role PLQ (pane_farm.hpp:152-162) ---
-        if plq_degree > 1:
-            self.plq = WinFarm(plq_func, pane, pane, win_type,
-                               pardegree=plq_degree, name=f"{name}_plq",
-                               incremental=plq_incremental,
-                               result_fields=plq_result_fields, ordered=True,
-                               config=cfg, role=Role.PLQ)
-        else:
-            plq_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
-                                    0, 1, pane)
-            self.plq = WinSeq(plq_func, pane, pane, win_type,
-                              name=f"{name}_plq", incremental=plq_incremental,
-                              result_fields=plq_result_fields, config=plq_cfg,
-                              role=Role.PLQ)
+        self.plq = self._make_stage(
+            "plq", plq_func, pane, pane, win_type, plq_degree,
+            name=f"{name}_plq", incremental=plq_incremental,
+            result_fields=plq_result_fields, ordered=True, role=Role.PLQ)
         # --- WLQ stage: CB window over the dense pane stream
         # --- (pane_farm.hpp:166-175) ---
-        wlq_win, wlq_slide = win_len // pane, slide_len // pane
-        if wlq_degree > 1:
-            self.wlq = WinFarm(wlq_func, wlq_win, wlq_slide, WinType.CB,
-                               pardegree=wlq_degree, name=f"{name}_wlq",
-                               incremental=wlq_incremental,
-                               result_fields=wlq_result_fields,
-                               ordered=ordered, config=cfg, role=Role.WLQ)
-        else:
-            wlq_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
-                                    0, 1, wlq_slide)
-            self.wlq = WinSeq(wlq_func, wlq_win, wlq_slide, WinType.CB,
-                              name=f"{name}_wlq", incremental=wlq_incremental,
-                              result_fields=wlq_result_fields, config=wlq_cfg,
-                              role=Role.WLQ)
+        self.wlq = self._make_stage(
+            "wlq", wlq_func, win_len // pane, slide_len // pane, WinType.CB,
+            wlq_degree, name=f"{name}_wlq", incremental=wlq_incremental,
+            result_fields=wlq_result_fields, ordered=ordered, role=Role.WLQ)
+
+    def _make_stage(self, which, func, win, slide, wt, degree, name,
+                    incremental, result_fields, ordered, role):
+        """Build one stage as Win_Seq (degree 1) or ordered Win_Farm —
+        overridable for device placement (Pane_Farm_GPU's 4 constructor
+        families, pane_farm_gpu.hpp:176-480, become a per-stage override)."""
+        cfg = self.config
+        if degree > 1:
+            return WinFarm(func, win, slide, wt, pardegree=degree, name=name,
+                           incremental=incremental,
+                           result_fields=result_fields, ordered=ordered,
+                           config=cfg, role=role)
+        seq_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
+                                0, 1, slide)
+        return WinSeq(func, win, slide, wt, name=name,
+                      incremental=incremental, result_fields=result_fields,
+                      config=seq_cfg, role=role)
 
     @property
     def result_schema(self):

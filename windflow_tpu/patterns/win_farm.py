@@ -199,13 +199,17 @@ class WinFarm(_Pattern):
             return WFCollectorNode(name=f"{self.name}.collector")
         return Collector(name=f"{self.name}.collector")
 
+    def _make_core(self, worker: WinSeq):
+        """Core-factory hook: TPU farms override to build device cores."""
+        return worker.make_core()
+
     def _make_replica(self, i):
-        w = self._workers[i]
+        core = self._make_core(self._workers[i])
         if self.n_emitters > 1:
             mode = OrderingMode.ID if self.spec.win_type is WinType.CB else OrderingMode.TS
-            node = _OrderedWorkerNode(w.make_core(), self.n_emitters, mode,
+            node = _OrderedWorkerNode(core, self.n_emitters, mode,
                                       f"{self.name}.{i}")
         else:
-            node = WinSeqNode(w.make_core(), f"{self.name}.{i}")
+            node = WinSeqNode(core, f"{self.name}.{i}")
         node.ctx = RuntimeContext(self.parallelism, i, self.name)
         return node

@@ -75,7 +75,8 @@ class _MapStage(_Pattern):
     reorder collector (win_mapreduce.hpp:147-163)."""
 
     def __init__(self, map_func, spec: WindowSpec, map_degree, name,
-                 incremental, result_fields, config: PatternConfig):
+                 incremental, result_fields, config: PatternConfig,
+                 device_fn=None, device_opts=None):
         super().__init__(name, map_degree)
         cfg = PatternConfig(config.id_inner, config.n_inner, config.slide_inner,
                             0, 1, spec.slide_len)
@@ -86,6 +87,8 @@ class _MapStage(_Pattern):
                    map_indexes=(i, map_degree))
             for i in range(map_degree)]
         self.spec = spec
+        self._device_fn = device_fn       # raw Reducer/JaxWindowFunction
+        self._device_opts = device_opts   # not None => device-batched MAP
 
     @property
     def result_schema(self):
@@ -99,7 +102,16 @@ class _MapStage(_Pattern):
         return WFCollectorNode(name=f"{self.name}.collector")
 
     def _make_replica(self, i):
-        node = WinSeqNode(self._workers[i].make_core(), f"{self.name}.{i}")
+        w = self._workers[i]
+        if self._device_opts is not None:
+            from .win_seq_tpu import DeviceWinSeqCore
+            core = DeviceWinSeqCore(
+                w.spec, self._device_fn, config=w.config, role=w.role,
+                map_indexes=w.map_indexes, result_ts_slide=w.result_ts_slide,
+                **self._device_opts)
+        else:
+            core = w.make_core()
+        node = WinSeqNode(core, f"{self.name}.{i}")
         node.ctx = RuntimeContext(self.parallelism, i, self.name)
         return node
 
@@ -127,24 +139,31 @@ class WinMapReduce:
         self.config = config or PatternConfig.plain(slide_len)
         cfg = self.config
         n = map_degree
-        self.map_stage = _MapStage(map_func, self.spec, n, f"{name}_map",
-                                   map_incremental, map_result_fields, cfg)
+        self.map_stage = self._make_map_stage(
+            map_func, n, f"{name}_map", map_incremental, map_result_fields)
         # REDUCE: CB window n/n over the dense partial stream
         # (win_mapreduce.hpp:173-183)
-        if reduce_degree > 1:
-            self.reduce_stage = WinFarm(
-                reduce_func, n, n, WinType.CB, pardegree=reduce_degree,
-                name=f"{name}_reduce", incremental=reduce_incremental,
-                result_fields=reduce_result_fields, ordered=ordered,
-                config=cfg, role=Role.REDUCE)
-        else:
-            red_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
-                                    0, 1, n)
-            self.reduce_stage = WinSeq(
-                reduce_func, n, n, WinType.CB, name=f"{name}_reduce",
-                incremental=reduce_incremental,
-                result_fields=reduce_result_fields, config=red_cfg,
-                role=Role.REDUCE)
+        self.reduce_stage = self._make_reduce_stage(
+            reduce_func, n, reduce_degree, f"{name}_reduce",
+            reduce_incremental, reduce_result_fields, ordered)
+
+    def _make_map_stage(self, map_func, n, name, incremental, result_fields):
+        return _MapStage(map_func, self.spec, n, name, incremental,
+                         result_fields, self.config)
+
+    def _make_reduce_stage(self, reduce_func, n, degree, name, incremental,
+                           result_fields, ordered):
+        cfg = self.config
+        if degree > 1:
+            return WinFarm(reduce_func, n, n, WinType.CB, pardegree=degree,
+                           name=name, incremental=incremental,
+                           result_fields=result_fields, ordered=ordered,
+                           config=cfg, role=Role.REDUCE)
+        red_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
+                                0, 1, n)
+        return WinSeq(reduce_func, n, n, WinType.CB, name=name,
+                      incremental=incremental, result_fields=result_fields,
+                      config=red_cfg, role=Role.REDUCE)
 
     @property
     def result_schema(self):

@@ -1,0 +1,362 @@
+"""Win_Seq_TPU: the sequential window core with device-batched evaluation —
+the TPU graft of the reference's Win_Seq_GPU (win_seq_gpu.hpp).
+
+Same window bookkeeping as the host core (it *is* the host core: one
+subclass hook), but fired NIC windows are not evaluated inline: their
+(start, len) ranges plus the staged archive slice are queued, and at
+``batch_len`` fired windows one XLA computation (or Pallas kernel)
+evaluates them all.  Result headers (key, renumbered id, result ts) are
+computed host-side at fire time, exactly like the reference pre-fills
+``host_results[i].setInfo(...)`` before the kernel (win_seq_gpu.hpp:447-449).
+Launches are asynchronous with bounded depth (vs the reference's per-batch
+``cudaStreamSynchronize``, :481); results are emitted in launch order, so
+per-key result order is preserved.
+
+EOS leftovers run through the same device path padded to the smallest
+bucket (the reference instead re-runs the functor on the CPU,
+win_seq_gpu.hpp:533-581 — unnecessary here since the contract is a JAX
+function, executable on any backend with identical semantics; that also
+covers the reference's "host-callable device functor" testing trick).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tuples import Schema
+from ..core.windows import PatternConfig, Role, WindowSpec, WinType
+from ..core.winseq import WinSeqCore
+from ..ops.device import DeviceWindowExecutor, builtin_batch_fn
+from ..ops.functions import Reducer
+from ..runtime.node import RuntimeContext
+from .basic import _Pattern
+from .key_farm import KeyFarm
+from .pane_farm import PaneFarm
+from .win_farm import WinFarm
+from .win_mapreduce import WinMapReduce
+from .win_seq import WinSeqNode
+
+
+class JaxWindowFunction:
+    """User window function for the device path: a JAX-traceable
+    ``fn(keys, gwids, cols, mask) -> column(s)`` over a whole window batch
+    — the TPU replacement for the reference's CUDA device functor
+    ``F(key, gwid, data, res, size, scratch)`` (win_seq_gpu.hpp:54-67,
+    deduced at meta_utils.hpp:173-180)."""
+
+    def __init__(self, fn, fields=("value",), result_fields=None):
+        self.fn = fn
+        self.fields = tuple(fields)
+        self.result_fields = dict(result_fields or {"value": np.int64})
+
+
+def _host_standin(winfunc):
+    """Host-side function object carrying the result schema for the
+    core/farm template plumbing (the device path never calls it)."""
+    if isinstance(winfunc, Reducer):
+        return winfunc
+    if isinstance(winfunc, JaxWindowFunction):
+        r = Reducer("count")
+        r.result_fields = dict(winfunc.result_fields)
+        return r
+    raise TypeError(
+        "the device path needs a builtin Reducer or a JaxWindowFunction "
+        "(host Python functions cannot be staged to the TPU — same "
+        "restriction as the reference's __device__ functor contract)")
+
+
+class DeviceWinSeqCore(WinSeqCore):
+    """WinSeqCore whose fired-window evaluation is device-batched."""
+
+    def __init__(self, spec: WindowSpec, winfunc, batch_len: int = 512,
+                 config: PatternConfig = None, role: Role = Role.SEQ,
+                 map_indexes=(0, 1), result_ts_slide=None, device=None,
+                 depth: int = 2, use_pallas: bool = False,
+                 compute_dtype=None):
+        host_fn = _host_standin(winfunc)
+        if isinstance(winfunc, Reducer):
+            executor = DeviceWindowExecutor(
+                builtin_batch_fn(winfunc.op, winfunc.field),
+                fields=winfunc.required_fields,
+                out_fields=tuple(winfunc.result_fields),
+                device=device, depth=depth, use_pallas=use_pallas,
+                op=winfunc.op, compute_dtype=compute_dtype)
+            self._stage_fields = tuple(winfunc.required_fields)
+        else:
+            executor = DeviceWindowExecutor(
+                winfunc.fn, fields=winfunc.fields,
+                out_fields=tuple(winfunc.result_fields),
+                device=device, depth=depth, compute_dtype=compute_dtype)
+            self._stage_fields = winfunc.fields
+        super().__init__(spec, host_fn, config=config, role=role,
+                         map_indexes=map_indexes,
+                         result_ts_slide=result_ts_slide)
+        self.executor = executor
+        self.batch_len = batch_len
+        # pending windows: list of (segment_cols, starts, lens) + headers
+        self._segs = []        # [(cols{f: np}, starts, lens)]
+        self._pending = 0
+        self._hdr = []         # [(key, ids, ts) per enqueue]
+
+    # -- device-batched NIC evaluation ------------------------------------
+
+    def _emit_windows(self, key, st, lwids, eos: bool):
+        spec = self.spec
+        gwids = st.first_gwid + lwids * self.config.gwid_stride()
+        ts = self._result_ts(st, lwids, gwids)
+        ids = self._renumber_ids(key, st, gwids)
+        starts_abs = spec.win_start(lwids) + st.initial_id
+        ends_abs = spec.win_end(lwids) + st.initial_id
+        p = st.archive.positions
+        lo = np.searchsorted(p, starts_abs, side="left")
+        hi = (np.full(len(lwids), len(p), dtype=np.int64) if eos
+              else np.searchsorted(p, ends_abs, side="left"))
+        base = int(lo[0]) if len(lo) else 0
+        top = int(hi[-1]) if len(hi) else 0
+        rows = st.archive.rows[base:top]
+        cols = {f: rows[f].copy() for f in self._stage_fields}
+        self._segs.append((cols, (lo - base).astype(np.int64),
+                           (hi - lo).astype(np.int64),
+                           np.full(len(lwids), key, dtype=np.int64), gwids))
+        self._hdr.append((key, ids, ts))
+        self._pending += len(lwids)
+        if not eos and len(lwids):
+            st.archive.purge_below(int(starts_abs[-1]))
+        if self._pending >= self.batch_len:
+            self._flush_batch()
+        return None
+
+    def _flush_batch(self):
+        if not self._segs:
+            return
+        flat = {f: [] for f in self._stage_fields}
+        starts, lens, keys, gwids = [], [], [], []
+        off = 0
+        for cols, s, l, k, g in self._segs:
+            for f in self._stage_fields:
+                flat[f].append(cols[f])
+            starts.append(s + off)
+            lens.append(l)
+            keys.append(k)
+            gwids.append(g)
+            off += len(next(iter(cols.values()))) if cols else 0
+        flat = {f: np.concatenate(v) if v else np.zeros(0, dtype=np.int64)
+                for f, v in flat.items()}
+        self.executor.launch(
+            list(self._hdr), flat,
+            np.concatenate(starts), np.concatenate(lens),
+            np.concatenate(keys), np.concatenate(gwids))
+        self._segs, self._hdr, self._pending = [], [], 0
+
+    # -- harvest ----------------------------------------------------------
+
+    def _build_results(self, harvested):
+        outs = []
+        for hdr, cols in harvested:
+            off = 0
+            for key, ids, ts in hdr:
+                n = len(ids)
+                payload = {f: v[off:off + n] for f, v in cols.items()}
+                outs.append(self._make_results(key, ids, ts, payload))
+                off += n
+        return outs
+
+    def process(self, batch):
+        super().process(batch)  # fired windows are enqueued, not returned
+        outs = self._build_results(self.executor.poll())
+        if not outs:
+            return np.zeros(0, dtype=self._result_dtype)
+        return np.concatenate(outs)
+
+    def flush(self):
+        super().flush()         # enqueue EOS leftovers
+        self._flush_batch()     # launch the partial batch
+        outs = self._build_results(self.executor.drain())
+        if not outs:
+            return np.zeros(0, dtype=self._result_dtype)
+        return np.concatenate(outs)
+
+    def use_incremental(self):
+        raise TypeError("the device path is non-incremental only "
+                        "(win_seq_gpu.hpp supports NIC device functors)")
+
+
+class WinSeqTPU(_Pattern):
+    """Sequential TPU window pattern (reference Win_Seq_GPU builder shape:
+    withBatch(batch_len) replaces withBatch(batch_len, n_thread_block))."""
+
+    def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
+                 batch_len=512, name="win_seq_tpu",
+                 config: PatternConfig = None, role: Role = Role.SEQ,
+                 map_indexes=(0, 1), result_ts_slide=None, device=None,
+                 depth=2, use_pallas=False, compute_dtype=None):
+        super().__init__(name, parallelism=1)
+        self.spec = WindowSpec(win_len, slide_len, win_type)
+        self._kw = dict(batch_len=batch_len, config=config, role=role,
+                        map_indexes=map_indexes,
+                        result_ts_slide=result_ts_slide, device=device,
+                        depth=depth, use_pallas=use_pallas,
+                        compute_dtype=compute_dtype)
+        self.winfunc = winfunc
+
+    def make_core(self):
+        return DeviceWinSeqCore(self.spec, self.winfunc, **self._kw)
+
+    @property
+    def result_schema(self):
+        return Schema(**self.winfunc.result_fields)
+
+    def _make_replica(self, i):
+        node = WinSeqNode(self.make_core(), f"{self.name}.{i}")
+        node.ctx = RuntimeContext(1, 0, self.name)
+        return node
+
+
+class WinFarmTPU(WinFarm):
+    """Win_Farm of device-batched window cores — the reference's
+    Win_Farm_GPU (win_farm_gpu.hpp:132-168: same emitter/collector as the
+    CPU farm, device workers). On one chip, workers share the device and
+    their async launch queues interleave (replacing per-worker CUDA
+    streams); multi-chip distribution is the mesh layer's job
+    (parallel/)."""
+
+    def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
+                 pardegree=2, batch_len=512, name="win_farm_tpu",
+                 ordered=True, n_emitters=1, config=None, role=Role.SEQ,
+                 device=None, depth=2, use_pallas=False, compute_dtype=None):
+        self._raw_fn = winfunc
+        self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
+                            use_pallas=use_pallas,
+                            compute_dtype=compute_dtype)
+        super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
+                         pardegree=pardegree, name=name, ordered=ordered,
+                         n_emitters=n_emitters, config=config, role=role)
+
+    def _make_core(self, worker):
+        return DeviceWinSeqCore(worker.spec, self._raw_fn,
+                                config=worker.config, role=worker.role,
+                                map_indexes=worker.map_indexes,
+                                result_ts_slide=worker.result_ts_slide,
+                                **self._dev_kw)
+
+
+class KeyFarmTPU(KeyFarm):
+    """Key_Farm of device-batched window cores (key_farm_gpu.hpp:151-161).
+    Keys stay resident per worker; the mesh layer maps workers to cores
+    over ICI with no collectives (SURVEY.md §7)."""
+
+    def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
+                 pardegree=2, batch_len=512, name="key_farm_tpu",
+                 routing=None, config=None, role=Role.SEQ, device=None,
+                 depth=2, use_pallas=False, compute_dtype=None):
+        self._raw_fn = winfunc
+        self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
+                            use_pallas=use_pallas,
+                            compute_dtype=compute_dtype)
+        super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
+                         pardegree=pardegree, name=name, routing=routing,
+                         config=config, role=role)
+
+    def _make_core(self, worker):
+        return DeviceWinSeqCore(worker.spec, self._raw_fn,
+                                config=worker.config, role=worker.role,
+                                map_indexes=worker.map_indexes,
+                                result_ts_slide=worker.result_ts_slide,
+                                **self._dev_kw)
+
+
+class PaneFarmTPU(PaneFarm):
+    """Pane_Farm with per-stage device placement — the 4 constructor
+    families of Pane_Farm_GPU (pane_farm_gpu.hpp:176-480) become two
+    booleans; an incremental stage always runs on the host (the reference
+    likewise pairs INC stages with host execution)."""
+
+    def __init__(self, plq_func, wlq_func, win_len, slide_len,
+                 win_type=WinType.CB, plq_degree=1, wlq_degree=1,
+                 name="pane_farm_tpu", plq_on_device=True, wlq_on_device=True,
+                 batch_len=512, device=None, depth=2, use_pallas=False,
+                 compute_dtype=None, **kw):
+        self._on_device = {"plq": plq_on_device, "wlq": wlq_on_device}
+        self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
+                            use_pallas=use_pallas,
+                            compute_dtype=compute_dtype)
+        super().__init__(plq_func, wlq_func, win_len, slide_len, win_type,
+                         plq_degree=plq_degree, wlq_degree=wlq_degree,
+                         name=name, **kw)
+
+    def _make_stage(self, which, func, win, slide, wt, degree, name,
+                    incremental, result_fields, ordered, role):
+        if not self._on_device.get(which) or incremental:
+            return super()._make_stage(which, func, win, slide, wt, degree,
+                                       name, incremental, result_fields,
+                                       ordered, role)
+        cfg = self.config
+        if degree > 1:
+            return WinFarmTPU(func, win, slide, wt, pardegree=degree,
+                              name=name, ordered=ordered, config=cfg,
+                              role=role, **self._dev_kw)
+        seq_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
+                                0, 1, slide)
+        return WinSeqTPU(func, win, slide, wt, name=name, config=seq_cfg,
+                         role=role, **self._dev_kw)
+
+    def clone_with(self, name, slide_len=None, config=None, ordered=False):
+        kw = dict(self._proto)
+        if slide_len is not None:
+            kw["slide_len"] = slide_len
+        return PaneFarmTPU(name=name, config=config, ordered=ordered,
+                           plq_on_device=self._on_device["plq"],
+                           wlq_on_device=self._on_device["wlq"],
+                           **self._dev_kw, **kw)
+
+
+class WinMapReduceTPU(WinMapReduce):
+    """Win_MapReduce with per-stage device placement
+    (win_mapreduce_gpu.hpp:171-521)."""
+
+    def __init__(self, map_func, reduce_func, win_len, slide_len,
+                 win_type=WinType.CB, map_degree=2, reduce_degree=1,
+                 name="win_mr_tpu", map_on_device=True,
+                 reduce_on_device=False, batch_len=512, device=None, depth=2,
+                 use_pallas=False, compute_dtype=None, **kw):
+        self._on_device = {"map": map_on_device, "reduce": reduce_on_device}
+        self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
+                            use_pallas=use_pallas,
+                            compute_dtype=compute_dtype)
+        super().__init__(map_func, reduce_func, win_len, slide_len, win_type,
+                         map_degree=map_degree, reduce_degree=reduce_degree,
+                         name=name, **kw)
+
+    def _make_map_stage(self, map_func, n, name, incremental, result_fields):
+        from .win_mapreduce import _MapStage
+        if not self._on_device["map"] or incremental:
+            return super()._make_map_stage(map_func, n, name, incremental,
+                                           result_fields)
+        return _MapStage(_host_standin(map_func), self.spec, n, name, None,
+                         result_fields, self.config, device_fn=map_func,
+                         device_opts=self._dev_kw)
+
+    def _make_reduce_stage(self, reduce_func, n, degree, name, incremental,
+                           result_fields, ordered):
+        if not self._on_device["reduce"] or incremental:
+            return super()._make_reduce_stage(reduce_func, n, degree, name,
+                                              incremental, result_fields,
+                                              ordered)
+        cfg = self.config
+        if degree > 1:
+            return WinFarmTPU(reduce_func, n, n, WinType.CB, pardegree=degree,
+                              name=name, ordered=ordered, config=cfg,
+                              role=Role.REDUCE, **self._dev_kw)
+        red_cfg = PatternConfig(cfg.id_inner, cfg.n_inner, cfg.slide_inner,
+                                0, 1, n)
+        return WinSeqTPU(reduce_func, n, n, WinType.CB, name=name,
+                         config=red_cfg, role=Role.REDUCE, **self._dev_kw)
+
+    def clone_with(self, name, slide_len=None, config=None, ordered=False):
+        kw = dict(self._proto)
+        if slide_len is not None:
+            kw["slide_len"] = slide_len
+        return WinMapReduceTPU(name=name, config=config, ordered=ordered,
+                               map_on_device=self._on_device["map"],
+                               reduce_on_device=self._on_device["reduce"],
+                               **self._dev_kw, **kw)
