@@ -189,3 +189,23 @@ def test_nested_tpu_inner():
     got = iv(run_windowed(WinFarmOf(inner, pardegree=2),
                           cb_stream_batches(keys, n)))
     assert got == iv(ref(16, 4, WinType.CB, cb_stream_batches(keys, n)))
+
+
+@pytest.mark.parametrize("op", ["min", "max"])
+def test_empty_windows_match_host_identity(op):
+    """A TB stream with a time gap produces empty windows; the device path
+    must emit the host Reducer identity (int64 extremes), not the narrowed
+    compute-dtype identity (regression: int32 iinfo leaked through)."""
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+
+    def gap_stream():
+        ids = np.arange(8)
+        ts = np.concatenate([ids[:4], ids[:4] + 100])
+        yield batch_from_columns(Schema(value=np.int64), key=np.zeros(8),
+                                 id=ids, ts=ts, value=ids + 1)
+
+    got = run_windowed(WinSeqTPU(Reducer(op), 10, 10, WinType.TB,
+                                 batch_len=4), list(gap_stream()))
+    want = run_windowed(WinSeq(Reducer(op), 10, 10, WinType.TB),
+                        list(gap_stream()))
+    assert got == want

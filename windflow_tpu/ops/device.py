@@ -37,6 +37,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .monoid import identity as _monoid_identity
+from .monoid import jnp_reducer
+
+_INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31 - 1
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     """Next power of two >= n (shape bucketing for jit reuse)."""
@@ -44,16 +49,6 @@ def _bucket(n: int, lo: int = 8) -> int:
     while b < n:
         b *= 2
     return b
-
-
-_JNP_OPS = {
-    "sum": (jnp.sum, 0),
-    "count": (None, 0),
-    "min": (jnp.min, None),   # identity filled per dtype
-    "max": (jnp.max, None),
-    "prod": (jnp.prod, 1),
-    "mean": (None, 0),
-}
 
 
 def builtin_batch_fn(op: str, field: str = "value"):
@@ -67,12 +62,8 @@ def builtin_batch_fn(op: str, field: str = "value"):
             s = jnp.sum(jnp.where(mask, vals, 0), axis=1)
             c = jnp.maximum(jnp.sum(mask, axis=1), 1)
             return s / c
-        reduce_fn, ident = _JNP_OPS[op]
-        if ident is None:
-            info = (jnp.finfo if jnp.issubdtype(vals.dtype, jnp.floating)
-                    else jnp.iinfo)(vals.dtype)
-            ident = info.max if op == "min" else info.min
-        return reduce_fn(jnp.where(mask, vals, ident), axis=1)
+        ident = _monoid_identity(op, vals.dtype)
+        return jnp_reducer(op)(jnp.where(mask, vals, ident), axis=1)
 
     return fn
 
@@ -83,7 +74,8 @@ class DeviceWindowExecutor:
 
     def __init__(self, batch_fn, fields=("value",), out_fields=("value",),
                  device=None, depth: int = 2, use_pallas: bool = False,
-                 op: str = None, compute_dtype=None):
+                 op: str = None, compute_dtype=None, out_dtypes=None,
+                 empty_fill=None):
         self.batch_fn = batch_fn
         self.fields = tuple(fields)
         self.out_fields = tuple(out_fields)
@@ -92,10 +84,18 @@ class DeviceWindowExecutor:
         self.use_pallas = use_pallas
         self.op = op
         self.compute_dtype = compute_dtype
+        # result dtypes per out_field: harvest casts into them so that
+        # empty-window fills (below) can hold full-width identities
+        self.out_dtypes = {f: np.dtype(d) for f, d in (out_dtypes or {}).items()}
+        # {field: value} written over empty windows at harvest — keeps the
+        # device path's empty-window results identical to the host path's
+        # even when compute happens in a narrower dtype (int32 vs int64)
+        self.empty_fill = dict(empty_fill or {})
         self._jits = {}      # (B, pad, N) -> compiled fn
-        self._inflight = []  # [(meta, device_results)]
+        self._inflight = []  # [(meta, B, empty_mask, device_results)]
         self._ready = []     # harvested result batches (host)
         self._warned_downcast = False
+        self._warned_id_range = False
 
     # ----------------------------------------------------------- compilation
 
@@ -168,6 +168,15 @@ class DeviceWindowExecutor:
                         stacklevel=3)
                 col = col.astype(np.int32)
             dcols[f] = pad1(col, Nb)
+        if not self._warned_id_range:
+            for name, a in (("keys", keys), ("gwids", gwids)):
+                if len(a) and (a.max() > _INT32_MAX or a.min() < _INT32_MIN):
+                    self._warned_id_range = True
+                    import warnings
+                    warnings.warn(
+                        f"device path downcasts {name} to int32 and "
+                        f"{int(a.max())} is out of range; a window function "
+                        "reading them will see wrapped values", stacklevel=3)
         args = jax.device_put(
             (dcols,
              pad1(starts.astype(np.int32), Bb),
@@ -186,19 +195,29 @@ class DeviceWindowExecutor:
             self.use_pallas = False
             self._jits.clear()
             out = self._compiled(Bb, pad, Nb)(*args)
-        self._inflight.append((meta, B, out))
+        empty = lens == 0 if self.empty_fill and (lens == 0).any() else None
+        self._inflight.append((meta, B, empty, out))
         while len(self._inflight) > self.depth:
             self._harvest_one()
 
     def _harvest_one(self):
-        meta, B, out = self._inflight.pop(0)
+        meta, B, empty, out = self._inflight.pop(0)
         host = [np.asarray(o)[:B] for o in out]  # blocks until ready
-        self._ready.append((meta, dict(zip(self.out_fields, host))))
+        cols = {}
+        for f, v in zip(self.out_fields, host):
+            dt = self.out_dtypes.get(f)
+            if dt is not None and v.dtype != dt:
+                v = v.astype(dt)
+            if empty is not None and f in self.empty_fill:
+                v = v.copy() if v.base is not None else v
+                v[empty] = self.empty_fill[f]
+            cols[f] = v
+        self._ready.append((meta, cols))
 
     def poll(self):
         """Harvest any completed launches without blocking on new ones;
         returns [(meta, {field: values})]."""
-        while self._inflight and self._is_ready(self._inflight[0][2]):
+        while self._inflight and self._is_ready(self._inflight[0][3]):
             self._harvest_one()
         ready, self._ready = self._ready, []
         return ready

@@ -101,12 +101,8 @@ class FnWindowUpdate(WindowUpdate):
         self.fn(key, gwid, row, acc)
 
 
-_UFUNCS = {
-    "sum": np.add,
-    "min": np.minimum,
-    "max": np.maximum,
-    "prod": np.multiply,
-}
+from .monoid import NP_UFUNCS as _UFUNCS
+from .monoid import identity as _monoid_identity
 
 
 class Reducer(WindowFunction, WindowUpdate):
@@ -133,14 +129,7 @@ class Reducer(WindowFunction, WindowUpdate):
 
     # identity element for empty windows / fresh accumulators
     def _identity(self):
-        if self.op in ("sum", "count"):
-            return 0
-        if self.op == "prod":
-            return 1
-        if self.op == "min":
-            return np.iinfo(self.dtype).max if self.dtype.kind in "iu" else np.inf
-        if self.op == "max":
-            return np.iinfo(self.dtype).min if self.dtype.kind in "iu" else -np.inf
+        return _monoid_identity(self.op, self.dtype)
 
     # --- NIC ---
     def apply(self, key, gwid, rows):

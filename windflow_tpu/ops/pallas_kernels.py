@@ -21,22 +21,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .monoid import identity as _identity
+from .monoid import jnp_reducer
+
 _GROUP = 8  # windows per program (one VPU sublane each)
-
-
-def _identity(op, dtype):
-    if op in ("sum", "count"):
-        return 0
-    if op == "prod":
-        return 1
-    info = (jnp.finfo if jnp.issubdtype(dtype, jnp.floating)
-            else jnp.iinfo)(dtype)
-    return info.max if op == "min" else info.min
-
-
-_REDUCERS = {
-    "sum": jnp.sum, "min": jnp.min, "max": jnp.max, "prod": jnp.prod,
-}
 
 
 def _kernel(starts_ref, lens_ref, flat_ref, out_ref, *, pad, op, dtype):
@@ -53,7 +41,7 @@ def _kernel(starts_ref, lens_ref, flat_ref, out_ref, *, pad, op, dtype):
             rows.append(l.astype(dtype))
         else:
             masked = jnp.where(lane < l, vals, ident)
-            rows.append(_REDUCERS[op](masked))
+            rows.append(jnp_reducer(op)(masked))
     out_ref[pl.ds(i * _GROUP, _GROUP)] = jnp.stack(rows)
 
 

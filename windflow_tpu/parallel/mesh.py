@@ -1,0 +1,212 @@
+"""Multi-chip window evaluation over a ``jax.sharding.Mesh`` — the scale-out
+layer the reference does not have (SURVEY.md §2.8: FastFlow is single-process;
+"distributed" there means threads). Here the five streaming parallelism
+strategies (SURVEY.md §2.7) become mesh axes:
+
+* ``kf`` axis — **group parallelism**: disjoint key groups (Key_Farm,
+  kf_nodes.hpp:38-82) or disjoint window subsets (Win_Farm round-robin,
+  wf_nodes.hpp:158-173) land on different devices.  Routing is done host-side
+  when batches are staged; on device the groups are embarrassingly parallel —
+  no collectives, shardings ride ICI for free.
+* ``sp`` axis — **window-partition parallelism** (Win_MapReduce,
+  win_mapreduce.hpp:147-183): each window's row range is split across the
+  ``sp`` shards; every shard reduces its slice (the MAP stage) and the
+  partials merge with an XLA collective over ICI (`psum` / gathered monoid
+  reduce — the REDUCE stage).  This is the streaming analog of sequence
+  parallelism over one long context.
+
+The combination is a 2D mesh: a (kf=4, sp=2) mesh runs 4 key groups, each
+evaluating its windows split over 2 chips.  Everything is jitted once per
+shape bucket (powers of two, like ops/device.py) and executed as one SPMD
+program — the XLA-native replacement for the reference's per-worker CUDA
+streams.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.device import _bucket
+
+KF_AXIS = "kf"   # key/window-group parallelism (no collectives)
+SP_AXIS = "sp"   # within-window partition parallelism (collectives over ICI)
+
+
+def make_mesh(n_kf: int = 1, n_sp: int = 1, devices=None) -> Mesh:
+    """A 2D (kf, sp) device mesh. ``n_kf * n_sp`` must not exceed the
+    device count; on a v5e-8 use e.g. (4, 2) or (8, 1)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_kf * n_sp
+    if need > len(devices):
+        raise ValueError(f"mesh ({n_kf}x{n_sp}) needs {need} devices, "
+                         f"have {len(devices)}")
+    grid = np.asarray(devices[:need], dtype=object).reshape(n_kf, n_sp)
+    return Mesh(grid, (KF_AXIS, SP_AXIS))
+
+
+from ..ops.monoid import identity as _identity
+from ..ops.monoid import jnp_reducer
+
+_OPS = ("sum", "count", "mean", "min", "max", "prod")
+
+
+class MeshWindowedReduce:
+    """Sharded batched window reduction: the multi-chip form of
+    ``DeviceWindowExecutor`` for built-in monoid ops.
+
+    Global layout (KF = kf-shards, each owning B windows over N rows):
+
+    * ``flat``  (KF, N) sharded ``P(kf, sp)`` — each sp shard holds a
+      contiguous N/sp row slice of each group's archive segment;
+    * ``starts``/``lens`` (KF, B) sharded ``P(kf, None)`` — window
+      descriptors, replicated over sp (tiny);
+    * result (KF, B) sharded ``P(kf, None)`` — every window's reduction,
+      identical on all sp shards after the collective.
+
+    Optional fused elementwise stages ride the same kernel (the device-side
+    analog of MultiPipe chaining): ``map_fn(values) -> values`` transforms
+    rows before windowing; ``filter_fn(values) -> bool`` *removes* rows from
+    the aggregation — dropped rows do not count toward count/mean and do not
+    contribute to any reduction, exactly like a chained Filter upstream of
+    the window operator.
+    """
+
+    def __init__(self, mesh: Mesh, op: str = "sum", dtype=jnp.int32,
+                 map_fn=None, filter_fn=None):
+        if op not in _OPS:
+            raise ValueError(f"unsupported op {op!r}")
+        self.mesh = mesh
+        self.op = op
+        self.dtype = jnp.dtype(dtype)
+        self.map_fn = map_fn
+        self.filter_fn = filter_fn
+        self.n_kf = mesh.shape[KF_AXIS]
+        self.n_sp = mesh.shape[SP_AXIS]
+        self._jits = {}
+
+    # ------------------------------------------------------------ compilation
+
+    def _build(self, B: int, pad: int, Ns: int):
+        """Compile for per-shard shapes: B windows/group, pad = max local
+        rows per window, Ns = rows per (kf, sp) shard."""
+        key = (B, pad, Ns)
+        fn = self._jits.get(key)
+        if fn is not None:
+            return fn
+
+        op, dtype = self.op, self.dtype
+        map_fn, filter_fn = self.map_fn, self.filter_fn
+        ident = _identity(op, dtype)
+
+        def local(flat, starts, lens):
+            # flat: (1, Ns); starts/lens: (1, B) — one (kf, sp) shard's view
+            r = jax.lax.axis_index(SP_AXIS).astype(jnp.int32)
+            base = r * Ns
+            v = flat[0]
+            if map_fn is not None:
+                v = map_fn(v)
+            lo = jnp.clip(starts[0] - base, 0, Ns)
+            hi = jnp.clip(starts[0] + lens[0] - base, 0, Ns)
+            iota = jnp.arange(pad, dtype=jnp.int32)
+            idx = jnp.minimum(lo[:, None] + iota[None, :], Ns - 1)
+            mask = iota[None, :] < (hi - lo)[:, None]
+            if filter_fn is not None:
+                # dropped rows leave the aggregation entirely (count too)
+                mask = mask & filter_fn(v)[idx]
+            if op == "count":
+                part = jnp.sum(mask, axis=1).astype(dtype)
+            else:
+                vals = jnp.where(mask, v[idx], ident).astype(dtype)
+                part = jnp_reducer(op)(vals, axis=1)
+            if op in ("sum", "count"):
+                out = jax.lax.psum(part, SP_AXIS)
+            elif op == "mean":
+                s = jax.lax.psum(part, SP_AXIS)
+                c = jax.lax.psum(jnp.sum(mask, axis=1), SP_AXIS)
+                out = s / jnp.maximum(c, 1).astype(dtype)
+            elif op == "min":
+                out = jax.lax.pmin(part, SP_AXIS)
+            elif op == "max":
+                out = jax.lax.pmax(part, SP_AXIS)
+            else:
+                # prod: gather the n_sp partials and fold locally (ICI
+                # all-gather of a (B,) vector — tiny); the static
+                # replication check cannot see through the local fold
+                allp = jax.lax.all_gather(part, SP_AXIS)  # (n_sp, B)
+                out = jnp_reducer(op)(allp, axis=0)
+            return out[None, :]
+
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(KF_AXIS, SP_AXIS), P(KF_AXIS, None),
+                      P(KF_AXIS, None)),
+            out_specs=P(KF_AXIS, None),
+            check_vma=(op != "prod"))
+        fn = jax.jit(mapped)
+        self._jits[key] = fn
+        return fn
+
+    # -------------------------------------------------------------- execution
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def __call__(self, flat: np.ndarray, starts: np.ndarray,
+                 lens: np.ndarray) -> np.ndarray:
+        """Evaluate all windows. ``flat`` is (KF, N) group rows; ``starts``
+        and ``lens`` are (KF, B) window descriptors (row offsets within the
+        group's flat segment). Returns (KF, B) reductions."""
+        KF, N = flat.shape
+        if KF != self.n_kf:
+            raise ValueError(f"flat has {KF} groups, mesh kf={self.n_kf}")
+        B = starts.shape[1]
+        Bb = _bucket(B)
+        # shard size: each sp shard gets Ns rows; pad the row axis so any
+        # [start, start+pad) window fits inside one shard's clip range
+        maxlen = int(lens.max()) if lens.size else 1
+        Ns = _bucket(max((N + self.n_sp - 1) // self.n_sp, 1))
+        pad = _bucket(min(max(maxlen, 1), Ns))
+
+        gflat = np.zeros((KF, Ns * self.n_sp), dtype=flat.dtype)
+        gflat[:, :N] = flat
+        gstarts = np.zeros((KF, Bb), dtype=np.int32)
+        gstarts[:, :B] = starts
+        glens = np.zeros((KF, Bb), dtype=np.int32)
+        glens[:, :B] = lens
+
+        dflat = jax.device_put(gflat, self.sharding(P(KF_AXIS, SP_AXIS)))
+        dstarts = jax.device_put(gstarts, self.sharding(P(KF_AXIS, None)))
+        dlens = jax.device_put(glens, self.sharding(P(KF_AXIS, None)))
+        out = self._build(Bb, pad, Ns)(dflat, dstarts, dlens)
+        return np.asarray(out)[:, :B]
+
+
+class MeshStreamStep:
+    """One full SPMD streaming step — the framework's "training step"
+    equivalent: fused elementwise Map and Filter stages feeding a
+    partitioned windowed reduction, compiled as a single XLA program over
+    the 2D mesh.  Filtered rows leave the aggregation entirely (count and
+    mean denominators included), exactly like a chained Filter upstream of
+    the window operator."""
+
+    def __init__(self, mesh: Mesh, op: str = "sum", dtype=jnp.int32,
+                 map_fn=None, filter_fn=None):
+        self.reduce = MeshWindowedReduce(mesh, op=op, dtype=dtype,
+                                         map_fn=map_fn, filter_fn=filter_fn)
+
+    def __call__(self, flat, starts, lens):
+        return self.reduce(flat, starts, lens)
+
+
+def partition_stream_by_key(batch_keys: np.ndarray, n_groups: int,
+                            routing=None) -> np.ndarray:
+    """Host-side key→group routing for the kf axis (the mesh form of
+    KF_Emitter's ``routing(key, n)``, kf_nodes.hpp:73). Returns the group
+    index per row; default is ``key % n`` (builders.hpp:190)."""
+    if routing is not None:
+        return np.asarray(routing(batch_keys, n_groups))
+    return batch_keys % n_groups

@@ -104,11 +104,8 @@ class _MapStage(_Pattern):
     def _make_replica(self, i):
         w = self._workers[i]
         if self._device_opts is not None:
-            from .win_seq_tpu import DeviceWinSeqCore
-            core = DeviceWinSeqCore(
-                w.spec, self._device_fn, config=w.config, role=w.role,
-                map_indexes=w.map_indexes, result_ts_slide=w.result_ts_slide,
-                **self._device_opts)
+            from .win_seq_tpu import make_device_core
+            core = make_device_core(w, self._device_fn, self._device_opts)
         else:
             core = w.make_core()
         node = WinSeqNode(core, f"{self.name}.{i}")

@@ -80,13 +80,18 @@ class DeviceWinSeqCore(WinSeqCore):
                 fields=winfunc.required_fields,
                 out_fields=tuple(winfunc.result_fields),
                 device=device, depth=depth, use_pallas=use_pallas,
-                op=winfunc.op, compute_dtype=compute_dtype)
+                op=winfunc.op, compute_dtype=compute_dtype,
+                out_dtypes=winfunc.result_fields,
+                # empty windows must produce the host-path identity even
+                # though device compute may run in a narrower dtype
+                empty_fill={winfunc.out_field: winfunc._identity()})
             self._stage_fields = tuple(winfunc.required_fields)
         else:
             executor = DeviceWindowExecutor(
                 winfunc.fn, fields=winfunc.fields,
                 out_fields=tuple(winfunc.result_fields),
-                device=device, depth=depth, compute_dtype=compute_dtype)
+                device=device, depth=depth, compute_dtype=compute_dtype,
+                out_dtypes=winfunc.result_fields)
             self._stage_fields = winfunc.fields
         super().__init__(spec, host_fn, config=config, role=role,
                          map_indexes=map_indexes,
@@ -181,6 +186,23 @@ class DeviceWinSeqCore(WinSeqCore):
                         "(win_seq_gpu.hpp supports NIC device functors)")
 
 
+def make_device_core(worker, fn, dev_kw) -> DeviceWinSeqCore:
+    """Build the device-batched core for a prototype host worker (a WinSeq
+    carrying the farm's per-worker spec/config/role plumbing)."""
+    return DeviceWinSeqCore(worker.spec, fn, config=worker.config,
+                            role=worker.role, map_indexes=worker.map_indexes,
+                            result_ts_slide=worker.result_ts_slide, **dev_kw)
+
+
+class _DeviceCoreFactory:
+    """Mixin for farm variants whose workers are device-batched: the host
+    farm builds its prototype workers, `_make_core` swaps in the device
+    core (set `_raw_fn` and `_dev_kw` before calling the farm ctor)."""
+
+    def _make_core(self, worker):
+        return make_device_core(worker, self._raw_fn, self._dev_kw)
+
+
 class WinSeqTPU(_Pattern):
     """Sequential TPU window pattern (reference Win_Seq_GPU builder shape:
     withBatch(batch_len) replaces withBatch(batch_len, n_thread_block))."""
@@ -212,7 +234,7 @@ class WinSeqTPU(_Pattern):
         return node
 
 
-class WinFarmTPU(WinFarm):
+class WinFarmTPU(_DeviceCoreFactory, WinFarm):
     """Win_Farm of device-batched window cores — the reference's
     Win_Farm_GPU (win_farm_gpu.hpp:132-168: same emitter/collector as the
     CPU farm, device workers). On one chip, workers share the device and
@@ -232,15 +254,8 @@ class WinFarmTPU(WinFarm):
                          pardegree=pardegree, name=name, ordered=ordered,
                          n_emitters=n_emitters, config=config, role=role)
 
-    def _make_core(self, worker):
-        return DeviceWinSeqCore(worker.spec, self._raw_fn,
-                                config=worker.config, role=worker.role,
-                                map_indexes=worker.map_indexes,
-                                result_ts_slide=worker.result_ts_slide,
-                                **self._dev_kw)
 
-
-class KeyFarmTPU(KeyFarm):
+class KeyFarmTPU(_DeviceCoreFactory, KeyFarm):
     """Key_Farm of device-batched window cores (key_farm_gpu.hpp:151-161).
     Keys stay resident per worker; the mesh layer maps workers to cores
     over ICI with no collectives (SURVEY.md §7)."""
@@ -256,13 +271,6 @@ class KeyFarmTPU(KeyFarm):
         super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
                          pardegree=pardegree, name=name, routing=routing,
                          config=config, role=role)
-
-    def _make_core(self, worker):
-        return DeviceWinSeqCore(worker.spec, self._raw_fn,
-                                config=worker.config, role=worker.role,
-                                map_indexes=worker.map_indexes,
-                                result_ts_slide=worker.result_ts_slide,
-                                **self._dev_kw)
 
 
 class PaneFarmTPU(PaneFarm):
