@@ -63,7 +63,7 @@ def test_mesh_windows_spanning_shard_boundaries():
     flat = np.arange(n_rows, dtype=np.int32)[None, :]
     starts = np.array([[0, 10, 60, 100]], dtype=np.int32)
     lens = np.array([[128, 50, 40, 28]], dtype=np.int32)
-    got = MeshWindowedReduce(make_mesh(1, 8), op="sum")(flat, starts, lens)
+    got = MeshWindowedReduce(mesh, op="sum")(flat, starts, lens)
     np.testing.assert_array_equal(got, _oracle(flat, starts, lens, "sum"))
 
 

@@ -32,6 +32,8 @@ __device__ lambda) and use the host path instead.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -42,6 +44,10 @@ from .monoid import jnp_reducer
 
 _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31 - 1
 
+#: process-wide compiled-function cache — executors come and go per pattern
+#: instance, the executables they compile should not
+_JIT_CACHE = {}
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     """Next power of two >= n (shape bucketing for jit reuse)."""
@@ -51,8 +57,11 @@ def _bucket(n: int, lo: int = 8) -> int:
     return b
 
 
+@functools.lru_cache(maxsize=None)
 def builtin_batch_fn(op: str, field: str = "value"):
-    """Batched window function for a built-in reduction, in JAX."""
+    """Batched window function for a built-in reduction, in JAX.  Cached so
+    every executor evaluating the same (op, field) shares one function
+    object — and therefore one compiled-executable cache entry."""
 
     def fn(keys, gwids, cols, mask):
         if op == "count":
@@ -65,6 +74,7 @@ def builtin_batch_fn(op: str, field: str = "value"):
         ident = _monoid_identity(op, vals.dtype)
         return jnp_reducer(op)(jnp.where(mask, vals, ident), axis=1)
 
+    fn._windflow_shared = True  # safe to cache executables process-wide
     return fn
 
 
@@ -73,7 +83,7 @@ class DeviceWindowExecutor:
     shapes and bounded asynchronous depth."""
 
     def __init__(self, batch_fn, fields=("value",), out_fields=("value",),
-                 device=None, depth: int = 2, use_pallas: bool = False,
+                 device=None, depth: int = 4, use_pallas: bool = False,
                  op: str = None, compute_dtype=None, out_dtypes=None,
                  empty_fill=None):
         self.batch_fn = batch_fn
@@ -91,7 +101,14 @@ class DeviceWindowExecutor:
         # device path's empty-window results identical to the host path's
         # even when compute happens in a narrower dtype (int32 vs int64)
         self.empty_fill = dict(empty_fill or {})
-        self._jits = {}      # (B, pad, N) -> compiled fn
+        # Executables compiled for process-lifetime functions (the lru-cached
+        # builtins, or anything marked _windflow_shared) go in the process-
+        # wide cache so new executor instances reuse them; ad-hoc user
+        # functions keep a per-instance cache (a global entry keyed on a
+        # short-lived lambda could never be reused but never dies either).
+        shared = (getattr(batch_fn, "_windflow_shared", False)
+                  or (use_pallas and op is not None and self.fields))
+        self._jits = _JIT_CACHE if shared else {}
         self._inflight = []  # [(meta, B, empty_mask, device_results)]
         self._ready = []     # harvested result batches (host)
         self._warned_downcast = False
@@ -100,7 +117,16 @@ class DeviceWindowExecutor:
     # ----------------------------------------------------------- compilation
 
     def _compiled(self, B, pad, N):
-        key = (B, pad, N)
+        # the jitted callable closes over (pad, N) only; B varies through the
+        # argument shapes, which jax.jit re-specialises on by itself.  Keyed
+        # process-wide on the user function object so a new executor (a new
+        # pattern instance, a re-run pipeline) reuses executables already
+        # compiled for the same function and bucket.
+        if self.use_pallas and self.op is not None and self.fields:
+            key = ("pallas", self.op, self.fields[0],
+                   self.device.platform, pad, N)
+        else:
+            key = (self.batch_fn, pad, N)
         fn = self._jits.get(key)
         if fn is not None:
             return fn
@@ -170,12 +196,16 @@ class DeviceWindowExecutor:
             dcols[f] = pad1(col, Nb)
         if not self._warned_id_range:
             for name, a in (("keys", keys), ("gwids", gwids)):
-                if len(a) and (a.max() > _INT32_MAX or a.min() < _INT32_MIN):
+                if a.dtype.itemsize <= 4 or not len(a):
+                    continue  # already fits int32: skip the O(B) scan
+                mx, mn = int(a.max()), int(a.min())
+                if mx > _INT32_MAX or mn < _INT32_MIN:
                     self._warned_id_range = True
+                    bad = mx if mx > _INT32_MAX else mn
                     import warnings
                     warnings.warn(
                         f"device path downcasts {name} to int32 and "
-                        f"{int(a.max())} is out of range; a window function "
+                        f"{bad} is out of range; a window function "
                         "reading them will see wrapped values", stacklevel=3)
         args = jax.device_put(
             (dcols,
@@ -191,10 +221,18 @@ class DeviceWindowExecutor:
                 raise
             # Mosaic may reject the kernel (e.g. unaligned rank-1 dynamic
             # slices on some toolchains) — fall back to the XLA gather path,
-            # which on a v5e measures >1e9 windows/s anyway
+            # which on a v5e measures >1e9 windows/s anyway (the gather key
+            # differs from the pallas key, so no cache invalidation needed)
             self.use_pallas = False
-            self._jits.clear()
+            if not getattr(self.batch_fn, "_windflow_shared", False):
+                # sharing was justified by the pallas key only; the gather
+                # path would key on an ad-hoc fn — keep those per-instance
+                self._jits = {}
             out = self._compiled(Bb, pad, Nb)(*args)
+        for o in out:
+            # start the D2H transfer now so harvest finds it on host —
+            # on a tunneled device a blocking fetch costs a full round-trip
+            getattr(o, "copy_to_host_async", lambda: None)()
         empty = lens == 0 if self.empty_fill and (lens == 0).any() else None
         self._inflight.append((meta, B, empty, out))
         while len(self._inflight) > self.depth:

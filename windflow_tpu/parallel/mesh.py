@@ -48,10 +48,9 @@ def make_mesh(n_kf: int = 1, n_sp: int = 1, devices=None) -> Mesh:
     return Mesh(grid, (KF_AXIS, SP_AXIS))
 
 
+from ..ops.monoid import OPS as _OPS
 from ..ops.monoid import identity as _identity
 from ..ops.monoid import jnp_reducer
-
-_OPS = ("sum", "count", "mean", "min", "max", "prod")
 
 
 class MeshWindowedReduce:
@@ -185,21 +184,11 @@ class MeshWindowedReduce:
         return np.asarray(out)[:, :B]
 
 
-class MeshStreamStep:
-    """One full SPMD streaming step — the framework's "training step"
-    equivalent: fused elementwise Map and Filter stages feeding a
-    partitioned windowed reduction, compiled as a single XLA program over
-    the 2D mesh.  Filtered rows leave the aggregation entirely (count and
-    mean denominators included), exactly like a chained Filter upstream of
-    the window operator."""
-
-    def __init__(self, mesh: Mesh, op: str = "sum", dtype=jnp.int32,
-                 map_fn=None, filter_fn=None):
-        self.reduce = MeshWindowedReduce(mesh, op=op, dtype=dtype,
-                                         map_fn=map_fn, filter_fn=filter_fn)
-
-    def __call__(self, flat, starts, lens):
-        return self.reduce(flat, starts, lens)
+#: One full SPMD streaming step — the framework's "training step"
+#: equivalent.  MeshWindowedReduce already fuses the elementwise Map and
+#: Filter stages into the partitioned windowed reduction; this name marks
+#: the whole-step usage.
+MeshStreamStep = MeshWindowedReduce
 
 
 def partition_stream_by_key(batch_keys: np.ndarray, n_groups: int,

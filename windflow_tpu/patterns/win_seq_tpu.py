@@ -71,7 +71,7 @@ class DeviceWinSeqCore(WinSeqCore):
     def __init__(self, spec: WindowSpec, winfunc, batch_len: int = 512,
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
-                 depth: int = 2, use_pallas: bool = False,
+                 depth: int = 4, use_pallas: bool = False,
                  compute_dtype=None):
         host_fn = _host_standin(winfunc)
         if isinstance(winfunc, Reducer):
@@ -211,7 +211,7 @@ class WinSeqTPU(_Pattern):
                  batch_len=512, name="win_seq_tpu",
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
-                 depth=2, use_pallas=False, compute_dtype=None):
+                 depth=4, use_pallas=False, compute_dtype=None):
         super().__init__(name, parallelism=1)
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self._kw = dict(batch_len=batch_len, config=config, role=role,
@@ -245,7 +245,7 @@ class WinFarmTPU(_DeviceCoreFactory, WinFarm):
     def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
                  pardegree=2, batch_len=512, name="win_farm_tpu",
                  ordered=True, n_emitters=1, config=None, role=Role.SEQ,
-                 device=None, depth=2, use_pallas=False, compute_dtype=None):
+                 device=None, depth=4, use_pallas=False, compute_dtype=None):
         self._raw_fn = winfunc
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
@@ -263,7 +263,7 @@ class KeyFarmTPU(_DeviceCoreFactory, KeyFarm):
     def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
                  pardegree=2, batch_len=512, name="key_farm_tpu",
                  routing=None, config=None, role=Role.SEQ, device=None,
-                 depth=2, use_pallas=False, compute_dtype=None):
+                 depth=4, use_pallas=False, compute_dtype=None):
         self._raw_fn = winfunc
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
@@ -282,7 +282,7 @@ class PaneFarmTPU(PaneFarm):
     def __init__(self, plq_func, wlq_func, win_len, slide_len,
                  win_type=WinType.CB, plq_degree=1, wlq_degree=1,
                  name="pane_farm_tpu", plq_on_device=True, wlq_on_device=True,
-                 batch_len=512, device=None, depth=2, use_pallas=False,
+                 batch_len=512, device=None, depth=4, use_pallas=False,
                  compute_dtype=None, **kw):
         self._on_device = {"plq": plq_on_device, "wlq": wlq_on_device}
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
@@ -325,7 +325,7 @@ class WinMapReduceTPU(WinMapReduce):
     def __init__(self, map_func, reduce_func, win_len, slide_len,
                  win_type=WinType.CB, map_degree=2, reduce_degree=1,
                  name="win_mr_tpu", map_on_device=True,
-                 reduce_on_device=False, batch_len=512, device=None, depth=2,
+                 reduce_on_device=False, batch_len=512, device=None, depth=4,
                  use_pallas=False, compute_dtype=None, **kw):
         self._on_device = {"map": map_on_device, "reduce": reduce_on_device}
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
