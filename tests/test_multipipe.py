@@ -1,0 +1,347 @@
+"""MultiPipe + builder tests — the pipe_test_cpu/pipe_test_gpu + union_test
+equivalents (SURVEY.md §4): full pipelines Source→Filter→FlatMap→Map→WinOp→
+Sink with randomized parallelism degrees, chaining variants asserting thread
+fusion, and unions of MultiPipes feeding windowed consumers."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from windflow_tpu import (Accumulator_Builder, Filter_Builder,
+                          FlatMap_Builder, KeyFarm_Builder, Map_Builder,
+                          MultiPipe, PaneFarm_Builder, Reducer, Schema,
+                          Sink_Builder, Source_Builder, WinFarm_Builder,
+                          WinMapReduce_Builder, WinSeq_Builder,
+                          WinSeqTPU_Builder, WinType, batch_from_columns,
+                          union_multipipes)
+
+SCHEMA = Schema(value=np.int64)
+
+
+def stream_batches(keys, n, chunk=64, id0=0, seed=None):
+    """Deterministic (or seeded-random-value) per-key-ordered stream."""
+    rng = np.random.default_rng(seed) if seed is not None else None
+    out = []
+    for i in range(0, n, chunk):
+        ids = np.repeat(np.arange(i, min(i + chunk, n)), keys)
+        ks = np.tile(np.arange(keys), len(ids) // keys)
+        vals = (rng.integers(0, 100, len(ids)).astype(np.int64)
+                if rng is not None else ids.astype(np.int64))
+        out.append(batch_from_columns(SCHEMA, key=ks, id=ids + id0,
+                                      ts=ids + id0, value=vals))
+    return out
+
+
+class Gather:
+    def __init__(self):
+        self.rows = []
+        self._lock = threading.Lock()
+
+    def __call__(self, row):
+        if row is None:
+            return
+        with self._lock:
+            self.rows.append((int(row["key"]), int(row["id"]),
+                              int(row["value"])))
+
+    @property
+    def total(self):
+        return sum(r[2] for r in self.rows)
+
+
+def source_of(batches):
+    return Source_Builder().withBatches(batches).withSchema(SCHEMA).build()
+
+
+# ----------------------------------------------------------- full pipelines
+
+@pytest.mark.parametrize("par", [1, 3])
+def test_pipe_basic_ops(par):
+    """Source→Filter(even)→Map(x2)→Sink; degrees randomized like
+    test_pipe_*.cpp re-draws (SURVEY.md §4)."""
+    got = Gather()
+    pipe = (MultiPipe("p1")
+            .add_source(source_of(stream_batches(2, 100)))
+            .add(Filter_Builder(lambda b: b["value"] % 2 == 0)
+                 .vectorized().withParallelism(par).build())
+            .add(Map_Builder(lambda b: b.__setitem__("value", b["value"] * 2))
+                 .vectorized().withParallelism(par).build())
+            .add_sink(Sink_Builder(got).build()))
+    pipe.run_and_wait_end()
+    want = sorted(2 * v for v in range(100) if v % 2 == 0) * 2
+    assert sorted(r[2] for r in got.rows) == sorted(want)
+
+
+def test_pipe_chained_vs_added_same_results_fewer_threads():
+    def build(chained):
+        got = Gather()
+        pipe = MultiPipe("p").add_source(source_of(stream_batches(1, 200)))
+        f = Filter_Builder(lambda b: b["value"] % 3 != 0).vectorized().build()
+        m = Map_Builder(lambda b: b.__setitem__("value", b["value"] + 7)) \
+            .vectorized().build()
+        s = Sink_Builder(got).build()
+        if chained:
+            pipe.chain(f).chain(m).chain_sink(s)
+        else:
+            pipe.add(f).add(m).add_sink(s)
+        return pipe, got
+
+    p_add, g_add = build(False)
+    p_chain, g_chain = build(True)
+    p_add.run_and_wait_end()
+    p_chain.run_and_wait_end()
+    assert sorted(g_add.rows) == sorted(g_chain.rows)
+    # chained: one fused thread (source+filter+map+sink)
+    assert p_chain.getNumThreads() == 1
+    assert p_add.getNumThreads() == 4
+
+
+def test_chain_falls_back_to_add_when_keyed_or_width_mismatch():
+    got = Gather()
+    pipe = (MultiPipe("p")
+            .add_source(source_of(stream_batches(4, 50)))
+            # keyed map cannot fuse (needs routing emitter)
+            .chain(Map_Builder(lambda b: b.__setitem__("value", b["value"]))
+                   .vectorized().keyBy().withParallelism(2).build())
+            .chain_sink(Sink_Builder(got).build()))
+    pipe.run_and_wait_end()
+    assert len(got.rows) == 200
+    # source / emitter / 2 workers / collector+sink — no fusion of the map
+    assert pipe.getNumThreads() >= 4
+
+
+def test_pipe_flatmap_and_accumulator():
+    out_schema = Schema(value=np.int64)
+
+    def dup(row, shipper):
+        shipper.push(key=int(row["key"]), id=int(row["id"]),
+                     ts=int(row["ts"]), value=int(row["value"]))
+        shipper.push(key=int(row["key"]), id=int(row["id"]),
+                     ts=int(row["ts"]), value=int(row["value"]) * 10)
+
+    def fold(row, acc):
+        acc["value"] += row["value"]
+
+    got = Gather()
+    (MultiPipe("p")
+     .add_source(source_of(stream_batches(2, 30)))
+     .add(FlatMap_Builder(dup).withOutputSchema(out_schema).build())
+     .add(Accumulator_Builder(fold).withResultSchema(Schema(value=np.int64))
+          .withParallelism(2).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    # accumulator emits one running total per input row; the last per key
+    # equals the key's grand total of 11 * sum(ids)
+    per_key = {}
+    for k, _, v in got.rows:
+        per_key[k] = max(per_key.get(k, 0), v)
+    want = 11 * sum(range(30))
+    assert per_key == {0: want, 1: want}
+
+
+# ----------------------------------------------------- windowed stages in MP
+
+def winseq_oracle(batches, win, slide, wt=WinType.CB):
+    from windflow_tpu import WinSeq
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+    from windflow_tpu.patterns.basic import Sink, Source
+    got = Gather()
+    df = Dataflow()
+    build_pipeline(df, [Source(batches=batches, schema=SCHEMA),
+                        WinSeq(Reducer("sum"), win, slide, wt),
+                        Sink(got)])
+    df.run_and_wait_end()
+    return sorted(got.rows)
+
+
+@pytest.mark.parametrize("builder_fn", [
+    lambda: WinSeq_Builder(Reducer("sum")).withCBWindow(16, 5).build(),
+    lambda: WinFarm_Builder(Reducer("sum")).withCBWindow(16, 5)
+        .withParallelism(3).withOrdered().build(),
+    lambda: KeyFarm_Builder(Reducer("sum")).withCBWindow(16, 5)
+        .withParallelism(2).build(),
+    lambda: PaneFarm_Builder(Reducer("sum"), Reducer("sum"))
+        .withCBWindow(16, 5).withParallelism(2, 2).build(),
+    lambda: WinMapReduce_Builder(Reducer("sum"), Reducer("sum"))
+        .withCBWindow(16, 5).withParallelism(2, 1).build(),
+    lambda: WinSeqTPU_Builder(Reducer("sum")).withCBWindow(16, 5)
+        .withBatch(32).build(),
+])
+def test_windowed_stage_differential(builder_fn):
+    """Every windowed pattern built fluently inside a MultiPipe matches the
+    Win_Seq oracle (the test_all_* differential harness shape)."""
+    batches = stream_batches(3, 120)
+    got = Gather()
+    (MultiPipe("wp")
+     .add_source(source_of(batches))
+     .add(builder_fn())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert sorted(got.rows) == winseq_oracle(batches, 16, 5)
+
+
+def test_full_pipeline_with_window_and_chaining():
+    """Source→chain(Filter)→WinFarm→chain(Sink): mixed fusion + shuffle."""
+    batches = stream_batches(2, 150)
+    got = Gather()
+    pipe = (MultiPipe("mix")
+            .add_source(source_of(batches))
+            .chain(Filter_Builder(lambda b: b["value"] >= 0)
+                   .vectorized().build())
+            .add(WinFarm_Builder(Reducer("sum")).withCBWindow(10, 10)
+                 .withParallelism(2).build())
+            .chain_sink(Sink_Builder(got).build()))
+    pipe.run_and_wait_end()
+    assert sorted(got.rows) == winseq_oracle(batches, 10, 10)
+
+
+# ------------------------------------------------------------------- unions
+
+def test_union_tumbling_cb_total_preserved():
+    """union_test analog: two source pipes into one CB tumbling-window sum.
+    TS_RENUMBERING merges by ts and renumbers per key, so totals and window
+    counts are interleave-invariant."""
+    W = 8
+    a = MultiPipe("a").add_source(source_of(stream_batches(2, 60, seed=1)))
+    b = MultiPipe("b").add_source(
+        source_of(stream_batches(2, 44, id0=60, seed=2)))
+    got = Gather()
+    u = (union_multipipes(a, b, name="u")
+         .add(WinSeq_Builder(Reducer("sum")).withCBWindow(W, W).build())
+         .add_sink(Sink_Builder(got).build()))
+    u.run_and_wait_end()
+    total_in = sum(int(bt["value"].sum())
+                   for bt in stream_batches(2, 60, seed=1)
+                   + stream_batches(2, 44, id0=60, seed=2))
+    assert got.total == total_in
+    per_key_n = 60 + 44
+    n_windows_per_key = -(-per_key_n // W)
+    assert len(got.rows) == 2 * n_windows_per_key
+
+
+def test_union_requires_two_sources_and_no_sinks():
+    a = MultiPipe("a").add_source(source_of(stream_batches(1, 5)))
+    with pytest.raises(ValueError):
+        union_multipipes(a)
+    g = Gather()
+    b = (MultiPipe("b").add_source(source_of(stream_batches(1, 5)))
+         .add_sink(Sink_Builder(g).build()))
+    with pytest.raises(ValueError):
+        union_multipipes(a, b)
+
+
+def test_union_of_union():
+    """Three-way union via nesting (test_union_3 analog)."""
+    pipes = [MultiPipe(f"s{i}").add_source(
+        source_of(stream_batches(1, 30, id0=30 * i))) for i in range(3)]
+    inner = union_multipipes(pipes[0], pipes[1])
+    got = Gather()
+    (union_multipipes(inner, pipes[2], name="u3")
+     .add(WinSeq_Builder(Reducer("count")).withCBWindow(6, 6).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert got.total == 90  # every tuple counted exactly once
+    assert len(got.rows) == 15
+
+
+def test_union_through_map_counts_every_tuple():
+    """Regression: a stage between the union and the windowed consumer must
+    not lose the ordering merge (tuples were silently dropped as
+    out-of-order before)."""
+    a = MultiPipe("a").add_source(source_of(stream_batches(1, 40)))
+    b = MultiPipe("b").add_source(source_of(stream_batches(1, 40, id0=40)))
+    got = Gather()
+    (union_multipipes(a, b)
+     .add(Map_Builder(lambda bt: bt.__setitem__("value", bt["value"] * 1))
+          .vectorized().build())
+     .add(WinSeq_Builder(Reducer("count")).withCBWindow(8, 8).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert got.total == 80
+    assert len(got.rows) == 10  # 80 tuples / tumbling 8
+
+
+def test_cb_window_after_filter_counts_survivors():
+    """CB windows downstream of a Filter follow the reference's
+    broadcast+TS_RENUMBERING semantics (multipipe.hpp:494-537): the window
+    holds `win` *surviving* tuples, not `win` original ids."""
+    batches = stream_batches(1, 100)
+    got = Gather()
+    (MultiPipe("f")
+     .add_source(source_of(batches))
+     .add(Filter_Builder(lambda b: b["value"] % 2 == 0).vectorized().build())
+     .add(WinSeq_Builder(Reducer("count")).withCBWindow(10, 10).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    # 50 survivors -> 5 full tumbling windows of 10
+    assert [r[2] for r in sorted(got.rows)] == [10] * 5
+
+
+def test_cb_window_after_parallel_map_is_exact():
+    """A non-keyed parallel stage interleaves worker outputs; the CB
+    consumer must still see every tuple exactly once, in renumbered order."""
+    batches = stream_batches(2, 96)
+    got = Gather()
+    (MultiPipe("pm")
+     .add_source(source_of(batches))
+     .add(Map_Builder(lambda b: b.__setitem__("value", np.ones_like(b["value"])))
+          .vectorized().withParallelism(3).build())
+     .add(WinSeq_Builder(Reducer("sum")).withCBWindow(12, 12).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert got.total == 2 * 96
+    assert len(got.rows) == 2 * 8
+
+
+# ---------------------------------------------------------------- api errors
+
+def test_multipipe_requires_source_first():
+    with pytest.raises(ValueError):
+        MultiPipe("x").add(Map_Builder(lambda b: b).vectorized().build())
+
+
+def test_multipipe_sink_closes_pipe():
+    p = (MultiPipe("x").add_source(source_of(stream_batches(1, 5)))
+         .add_sink(Sink_Builder(Gather()).build()))
+    with pytest.raises(ValueError):
+        p.add(Map_Builder(lambda b: b).vectorized().build())
+
+
+def test_cb_window_after_parallel_source_is_exact():
+    """Regression: replicated sources interleave at their collector; the
+    windowed consumer still sees every tuple exactly once."""
+    per_replica = [stream_batches(1, 48, id0=48 * i) for i in range(2)]
+    got = Gather()
+    (MultiPipe("ps")
+     .add_source(Source_Builder().withBatches(lambda i: per_replica[i])
+                 .withSchema(SCHEMA).withParallelism(2).build())
+     .add(WinSeq_Builder(Reducer("count")).withCBWindow(8, 8).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert got.total == 96
+    assert len(got.rows) == 12
+
+
+def test_get_num_threads_keeps_pipe_open():
+    got = Gather()
+    p = (MultiPipe("x").add_source(source_of(stream_batches(1, 10)))
+         .add(Map_Builder(lambda b: b).vectorized().build()))
+    n_before = p.getNumThreads()
+    p.add_sink(Sink_Builder(got).build())  # must still be allowed
+    p.run_and_wait_end()
+    assert len(got.rows) == 10
+    assert p.getNumThreads() == n_before + 1
+
+
+def test_builder_option_passthrough():
+    wf = (WinFarm_Builder(Reducer("max")).withName("w").withCBWindow(9, 4)
+          .withParallelism(5).withOrdered(False).build())
+    assert wf.name == "w" and wf.parallelism == 5 and not wf.ordered
+    assert wf.spec.win_len == 9 and wf.spec.slide_len == 4
+    tpu = (WinSeqTPU_Builder(Reducer("sum")).withTBWindow(1000, 500)
+           .withBatch(64).build())
+    assert tpu.spec.win_type is WinType.TB
+
+
+def test_builder_cuda_args_warn_and_ignore():
+    with pytest.warns(UserWarning):
+        WinSeqTPU_Builder(Reducer("sum")).withCBWindow(4, 2) \
+            .withBatch(32, n_thread_block=128).build()
+    with pytest.warns(UserWarning):
+        WinSeqTPU_Builder(Reducer("sum")).withCBWindow(4, 2) \
+            .withScratchpad(64).build()

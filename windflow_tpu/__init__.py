@@ -1,0 +1,57 @@
+"""windflow_tpu — a TPU-native stream-processing framework with the
+capabilities of the reference WindFlow library (C++/CUDA, see SURVEY.md).
+
+This umbrella module mirrors the reference's ``windflow.hpp`` +
+``windflow_gpu.hpp`` include sets: everything a user application needs —
+patterns, builders, MultiPipe — importable from the top level.  The
+device-backed patterns (``*TPU``) are the ``windflow_gpu.hpp:33-38``
+equivalents.
+"""
+
+from .api import (LEVEL0, LEVEL1, LEVEL2, Accumulator_Builder,
+                  Filter_Builder, FlatMap_Builder, KeyFarm_Builder,
+                  KeyFarmTPU_Builder, Map_Builder, MultiPipe,
+                  PaneFarm_Builder, PaneFarmTPU_Builder, Sink_Builder,
+                  Source_Builder, WinFarm_Builder, WinFarmTPU_Builder,
+                  WinMapReduce_Builder, WinMapReduceTPU_Builder,
+                  WinSeq_Builder, WinSeqTPU_Builder, union_multipipes)
+from .core.tuples import Schema, batch_from_columns
+from .core.windows import WinType
+from .ops.functions import (FnWindowFunction, FnWindowUpdate, Reducer,
+                            WindowFunction, WindowUpdate)
+from .patterns.basic import (Accumulator, Filter, FlatMap, Map, Shipper,
+                             Sink, Source)
+from .patterns.key_farm import KeyFarm
+from .patterns.nesting import KeyFarmOf, WinFarmOf
+from .patterns.pane_farm import PaneFarm
+from .patterns.win_farm import WinFarm
+from .patterns.win_mapreduce import WinMapReduce
+from .patterns.win_seq import WinSeq
+from .patterns.win_seq_tpu import (JaxWindowFunction, KeyFarmTPU,
+                                   PaneFarmTPU, WinFarmTPU, WinMapReduceTPU,
+                                   WinSeqTPU)
+from .runtime.node import RuntimeContext
+
+__version__ = "0.1.0"
+
+__all__ = [
+    # core
+    "Schema", "batch_from_columns", "WinType", "RuntimeContext",
+    # window-function contracts
+    "WindowFunction", "WindowUpdate", "FnWindowFunction", "FnWindowUpdate",
+    "Reducer", "JaxWindowFunction",
+    # patterns
+    "Source", "Map", "Filter", "FlatMap", "Accumulator", "Sink", "Shipper",
+    "WinSeq", "WinFarm", "KeyFarm", "PaneFarm", "WinMapReduce",
+    "WinFarmOf", "KeyFarmOf",
+    "WinSeqTPU", "WinFarmTPU", "KeyFarmTPU", "PaneFarmTPU",
+    "WinMapReduceTPU",
+    # composition
+    "MultiPipe", "union_multipipes",
+    "Source_Builder", "Filter_Builder", "Map_Builder", "FlatMap_Builder",
+    "Accumulator_Builder", "Sink_Builder", "WinSeq_Builder",
+    "WinFarm_Builder", "KeyFarm_Builder", "PaneFarm_Builder",
+    "WinMapReduce_Builder", "WinSeqTPU_Builder", "WinFarmTPU_Builder",
+    "KeyFarmTPU_Builder", "PaneFarmTPU_Builder", "WinMapReduceTPU_Builder",
+    "LEVEL0", "LEVEL1", "LEVEL2",
+]

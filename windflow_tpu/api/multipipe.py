@@ -1,0 +1,259 @@
+"""MultiPipe — the linear pipeline composer (reference multipipe.hpp:
+``add_source / add / chain / add_sink / chain_sink / unionMultiPipes /
+run / run_and_wait_end``).
+
+The reference builds nested ff_a2a "matrioskas" and splices emitters onto
+producer pipelines at add time (multipipe.hpp:174-240).  Here composition is
+*deferred*: ``add``/``chain`` record stages, and the graph is materialised
+once at ``run()``:
+
+* ``add(p)`` wires p as its own farm (emitter -> replicas -> collector)
+  fed by the current tail — the Case-2 "shuffle" of add_operator.
+* ``chain(p)`` fuses p's workers into the previous stage's worker threads
+  (one :class:`~windflow_tpu.runtime.comb.Comb` per replica — the
+  chain_operator / ff_comb path, multipipe.hpp:244-271).  Chaining requires
+  a non-keyed pattern of equal width; otherwise it degrades to ``add``
+  exactly like the reference's width checks force a shuffle.
+* ``union`` merges several MultiPipes into one (multipipe.hpp:909-940);
+  an OrderingNode is interposed before order-sensitive consumers (windowed
+  or keyed patterns), with TS_RENUMBERING for count-windows — the mode table
+  of MultiPipe::add (multipipe.hpp:494-537).
+"""
+
+from __future__ import annotations
+
+from ..core.windows import WinType
+from ..runtime.comb import make_comb
+from ..runtime.engine import Dataflow
+from ..runtime.farm import add_farm
+from ..runtime.ordering import OrderingMode, OrderingNode
+
+
+def _window_spec(pattern):
+    return getattr(pattern, "spec", None)
+
+
+def _is_keyed(pattern):
+    return getattr(pattern, "routing", None) is not None
+
+
+def _is_composite(pattern):
+    return hasattr(pattern, "instantiate")
+
+
+def _chainable(pattern, group):
+    """chain_operator preconditions (multipipe.hpp:244-271): same width,
+    non-keyed, and a simple (non-composite) pattern on both sides."""
+    if _is_composite(pattern) or _is_keyed(pattern):
+        return False
+    head = group[0]
+    if _is_composite(head):
+        return False
+    return pattern.parallelism == head.parallelism
+
+
+class _FusedPattern:
+    """A chain group presented as one pattern: replica i is the Comb of
+    every member's replica i; the shell comes from the ends."""
+
+    def __init__(self, group):
+        self.group = group
+        self.parallelism = group[0].parallelism
+        self.name = "+".join(p.name for p in group)
+
+    def replicas(self):
+        per = [p.replicas() for p in self.group]
+        return [make_comb([per[s][i] for s in range(len(per))])
+                for i in range(self.parallelism)]
+
+    def emitter(self):
+        return self.group[0].emitter()
+
+    def collector(self):
+        return self.group[-1].collector()
+
+
+class MultiPipe:
+    """Deferred-construction pipeline of patterns.  Instances are also the
+    operands of :func:`union_multipipes`."""
+
+    def __init__(self, name: str = "pipe"):
+        self.name = name
+        self._stages: list[tuple[str, object]] = []  # (kind, pattern)
+        self._branches: list[MultiPipe] = []
+        self._has_source = False
+        self._has_sink = False
+        self._df: Dataflow | None = None
+
+    # ------------------------------------------------------------- builders
+
+    def _check_open(self):
+        if self._has_sink:
+            raise ValueError(f"MultiPipe {self.name!r} already has a sink")
+        if self._df is not None:
+            raise ValueError(f"MultiPipe {self.name!r} is already running")
+
+    def add_source(self, source) -> "MultiPipe":
+        self._check_open()
+        if self._has_source or self._branches:
+            raise ValueError("MultiPipe already has a source")
+        self._has_source = True
+        self._stages.append(("add", source))
+        return self
+
+    def add(self, pattern) -> "MultiPipe":
+        self._check_open()
+        self._require_input()
+        self._stages.append(("add", pattern))
+        return self
+
+    def chain(self, pattern) -> "MultiPipe":
+        self._check_open()
+        self._require_input()
+        self._stages.append(("chain", pattern))
+        return self
+
+    def add_sink(self, sink) -> "MultiPipe":
+        self._check_open()
+        self._require_input()
+        self._stages.append(("add", sink))
+        self._has_sink = True
+        return self
+
+    def chain_sink(self, sink) -> "MultiPipe":
+        self._check_open()
+        self._require_input()
+        self._stages.append(("chain", sink))
+        self._has_sink = True
+        return self
+
+    def _require_input(self):
+        if not (self._has_source or self._branches):
+            raise ValueError("add a source first (or union MultiPipes)")
+
+    # ---------------------------------------------------------------- build
+
+    def _group_stages(self):
+        groups = []
+        for kind, p in self._stages:
+            if kind == "chain" and groups and _chainable(p, groups[-1]):
+                groups[-1].append(p)
+            else:
+                groups.append([p])
+        return groups
+
+    def _maybe_order(self, df, tails, group, ordered, dense):
+        """Interpose the right merge in front of an order-sensitive consumer
+        — the OrderingNode mode table of MultiPipe::add
+        (multipipe.hpp:377-537): count-windows over a stream whose per-key
+        ids are no longer pristine (filtered/flat-mapped/unioned/unordered)
+        get a TS_RENUMBERING front-end, so CB means "count of arriving
+        tuples per key" exactly like the reference's broadcast+renumber CB
+        path (:494-537); time-windows and keyed state get a TS merge when
+        the stream is unordered or multi-tailed."""
+        specs = [s for s in (_window_spec(p) for p in group) if s is not None]
+        cb = any(s.win_type is WinType.CB for s in specs)
+        sensitive = bool(specs) or any(_is_keyed(p) for p in group)
+        disordered = not ordered or len(tails) > 1
+        if cb and (disordered or not dense):
+            mode = OrderingMode.TS_RENUMBERING
+        elif sensitive and disordered:
+            mode = OrderingMode.TS
+        else:
+            return tails, ordered, dense
+        onode = OrderingNode(max(len(tails), 1), mode,
+                             name=f"{self.name}.order_merge")
+        df.add(onode)
+        for t in tails:
+            df.connect(t, onode)
+        return [onode], True, (dense or mode is OrderingMode.TS_RENUMBERING)
+
+    @staticmethod
+    def _stream_effect(group, ordered, dense):
+        """How a wired group changes the stream's (ordered, dense-ids)
+        invariants for what flows downstream of it."""
+        for p in group:
+            if _window_spec(p) is not None:
+                # windowed results carry fresh per-key window ids; ordered
+                # collectors (default) restore emission order
+                ordered = getattr(p, "ordered", True)
+                dense = True
+                continue
+            cls = type(p).__name__
+            if cls in ("Filter", "FlatMap"):
+                dense = False  # rows dropped / multiplied
+            if getattr(p, "parallelism", 1) > 1 and not _is_keyed(p):
+                # non-keyed parallel stage (parallel sources included):
+                # the collector interleaves replica outputs
+                ordered = False
+        return ordered, dense
+
+    def _build_into(self, df: Dataflow):
+        tails = []
+        ordered, dense = True, True
+        for b in self._branches:
+            tails.extend(b._build_into(df))
+        if len(self._branches) > 1:
+            ordered, dense = False, False  # cross-branch interleave, id clash
+        for group in self._group_stages():
+            pattern = group[0] if len(group) == 1 else _FusedPattern(group)
+            tails, ordered, dense = self._maybe_order(
+                df, tails, group, ordered, dense)
+            tails = add_farm(df, pattern, tails)
+            ordered, dense = self._stream_effect(group, ordered, dense)
+        return tails
+
+    def _build(self) -> Dataflow:
+        if self._df is None:
+            df = Dataflow(self.name)
+            self._build_into(df)
+            self._df = df
+        return self._df
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> "MultiPipe":
+        self._build().run()
+        return self
+
+    def wait(self):
+        if self._df is None:
+            raise RuntimeError("run() first")
+        self._df.wait()
+
+    def run_and_wait_end(self):
+        self._build().run_and_wait_end()
+
+    def getNumThreads(self) -> int:
+        """Thread count of the materialised graph (multipipe.hpp:973).
+        Before run() this builds a throwaway preview graph, so the pipe
+        stays open for further add()/chain() calls."""
+        if self._df is not None:
+            return self._df.cardinality()
+        df = Dataflow(self.name)
+        self._build_into(df)
+        return df.cardinality()
+
+    # ---------------------------------------------------------------- union
+
+    @staticmethod
+    def union(*pipes: "MultiPipe", name: str = "union") -> "MultiPipe":
+        return union_multipipes(*pipes, name=name)
+
+
+def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
+    """Merge several source-bearing MultiPipes into one downstream pipe
+    (multipipe.hpp:909-940).  The operands must not have sinks; the merged
+    pipe continues with add/chain/add_sink."""
+    if len(pipes) < 2:
+        raise ValueError("union needs at least two MultiPipes")
+    for p in pipes:
+        if p._has_sink:
+            raise ValueError(f"cannot union {p.name!r}: it has a sink")
+        if not (p._has_source or p._branches):
+            raise ValueError(f"cannot union {p.name!r}: it has no source")
+        if p._df is not None:
+            raise ValueError(f"cannot union {p.name!r}: already running")
+    merged = MultiPipe(name)
+    merged._branches = list(pipes)
+    return merged

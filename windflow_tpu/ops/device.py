@@ -48,6 +48,11 @@ _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31 - 1
 #: instance, the executables they compile should not
 _JIT_CACHE = {}
 
+#: platforms where Mosaic rejected the pallas kernel — recorded so later
+#: executors skip straight to the gather path instead of re-paying the
+#: failing compile (jax does not cache failed compiles)
+_PALLAS_BROKEN = set()
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     """Next power of two >= n (shape bucketing for jit reuse)."""
@@ -122,6 +127,8 @@ class DeviceWindowExecutor:
         # process-wide on the user function object so a new executor (a new
         # pattern instance, a re-run pipeline) reuses executables already
         # compiled for the same function and bucket.
+        if self.use_pallas and self.device.platform in _PALLAS_BROKEN:
+            self.use_pallas = False
         if self.use_pallas and self.op is not None and self.fields:
             key = ("pallas", self.op, self.fields[0],
                    self.device.platform, pad, N)
@@ -196,8 +203,10 @@ class DeviceWindowExecutor:
             dcols[f] = pad1(col, Nb)
         if not self._warned_id_range:
             for name, a in (("keys", keys), ("gwids", gwids)):
-                if a.dtype.itemsize <= 4 or not len(a):
-                    continue  # already fits int32: skip the O(B) scan
+                fits = (a.dtype.kind == "i" and a.dtype.itemsize <= 4) or \
+                       (a.dtype.kind == "u" and a.dtype.itemsize <= 2)
+                if fits or not len(a):
+                    continue  # provably within int32: skip the O(B) scan
                 mx, mn = int(a.max()), int(a.min())
                 if mx > _INT32_MAX or mn < _INT32_MIN:
                     self._warned_id_range = True
@@ -221,8 +230,13 @@ class DeviceWindowExecutor:
                 raise
             # Mosaic may reject the kernel (e.g. unaligned rank-1 dynamic
             # slices on some toolchains) — fall back to the XLA gather path,
-            # which on a v5e measures >1e9 windows/s anyway (the gather key
-            # differs from the pallas key, so no cache invalidation needed)
+            # which on a v5e measures >1e9 windows/s anyway.  Evict the
+            # failing entry and mark the platform so later executors skip
+            # straight to the gather path.
+            _JIT_CACHE.pop(("pallas", self.op,
+                            self.fields[0] if self.fields else None,
+                            self.device.platform, pad, Nb), None)
+            _PALLAS_BROKEN.add(self.device.platform)
             self.use_pallas = False
             if not getattr(self.batch_fn, "_windflow_shared", False):
                 # sharing was justified by the pallas key only; the gather

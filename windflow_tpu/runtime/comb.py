@@ -1,0 +1,93 @@
+"""Node fusion — the runtime's equivalent of FastFlow's ``ff_comb``
+(``ff/combine.hpp``, used by the reference for chaining at
+multipipe.hpp:244-271 and for LEVEL1/2 optimisation at pane_farm.hpp:435-464):
+several nodes execute in ONE thread, with the upstream node's emissions
+delivered synchronously into the downstream node's ``svc`` instead of
+through a queue.
+
+Fusion preserves every lifecycle guarantee of the engine contract: inner
+``svc_init``/``svc_end`` run in the (single) combined thread, and EOS
+flushing cascades stage by stage — stage i's ``eosnotify`` may still emit,
+and those emissions are seen by stage i+1 *before* its own ``eosnotify``.
+"""
+
+from __future__ import annotations
+
+from .node import Node, SourceNode
+
+
+class _SyncOut:
+    """Output channel that delivers synchronously into the next fused stage
+    (replaces the inter-thread Inbox; same ``put`` shape)."""
+
+    __slots__ = ("dst", "channel")
+
+    def __init__(self, dst: Node, channel: int = 0):
+        self.dst = dst
+        self.channel = channel
+
+    def put(self, src, batch):
+        self.dst.svc(batch, self.channel)
+
+    def put_eos(self, src):  # EOS is driven by Comb's lifecycle, not queues
+        pass
+
+
+class Comb(Node):
+    """Run `stages` fused in one thread: stage i's emit() calls stage i+1's
+    svc() directly; the last stage's emissions leave through the Comb's own
+    output channels."""
+
+    def __init__(self, stages: list[Node], name: str = None):
+        if not stages:
+            raise ValueError("Comb needs at least one stage")
+        super().__init__(name or "+".join(s.name for s in stages))
+        self.stages = list(stages)
+        for a, b in zip(self.stages, self.stages[1:]):
+            a._outputs = [(_SyncOut(b), 0)]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def svc_init(self):
+        # the engine wired the graph's edges onto the Comb itself; the last
+        # stage emits through them
+        self.stages[-1]._outputs = self._outputs
+        self.stages[0].n_input_channels = self.n_input_channels
+        for s in self.stages[1:]:
+            s.n_input_channels = 1
+        for s in self.stages:
+            s.stats = self.stats
+            s.svc_init()
+
+    def svc(self, batch, channel: int = 0):
+        self.stages[0].svc(batch, channel)
+
+    def on_channel_eos(self, channel: int):
+        self.stages[0].on_channel_eos(channel)
+
+    def eosnotify(self):
+        # cascade: flushing stage i may emit into stage i+1 (synchronously),
+        # which then flushes its own state on top
+        for i, s in enumerate(self.stages):
+            s.eosnotify()
+            if i + 1 < len(self.stages):
+                self.stages[i + 1].on_channel_eos(0)
+
+    def svc_end(self):
+        for s in self.stages:
+            s.svc_end()
+
+
+class SourceComb(Comb, SourceNode):
+    """Comb whose first stage is a source: the engine drives ``generate``
+    (sources are dispatched by type, engine.py) and the generated batches
+    flow synchronously through the fused downstream stages."""
+
+    def generate(self):
+        self.stages[0].generate()
+
+
+def make_comb(stages: list[Node], name: str = None) -> Comb:
+    """Fuse `stages` into one schedulable node, source-aware."""
+    cls = SourceComb if isinstance(stages[0], SourceNode) else Comb
+    return cls(stages, name)
