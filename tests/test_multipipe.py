@@ -317,6 +317,47 @@ def test_cb_window_after_parallel_source_is_exact():
     assert len(got.rows) == 12
 
 
+def test_tb_window_after_parallel_map_loses_nothing():
+    """Regression: worker outputs stay as separate ordered channels into a
+    real k-way TS merge; a blind collector merge used to hand the ordering
+    core interleaved rows and TB windows silently dropped tuples."""
+    batches = stream_batches(1, 1024)
+    got = Gather()
+    (MultiPipe("tbp")
+     .add_source(source_of(batches))
+     .add(Map_Builder(lambda b: b.__setitem__("value", np.ones_like(b["value"])))
+          .vectorized().withParallelism(4).build())
+     .add(WinSeq_Builder(Reducer("sum")).withTBWindow(64, 64).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert got.total == 1024
+
+
+def test_cb_window_after_accumulator_renumbers():
+    """Regression: accumulator snapshots reuse input ids which are not
+    window-meaningful; the downstream CB window must renumber (one window
+    per `win` snapshots), not collapse everything into window 0."""
+    def fold(row, acc):
+        acc["value"] += row["value"]
+
+    got = Gather()
+    (MultiPipe("accw")
+     .add_source(source_of(stream_batches(1, 100)))
+     .add(Accumulator_Builder(fold).withResultSchema(Schema(value=np.int64))
+          .build())
+     .add(WinSeq_Builder(Reducer("count")).withCBWindow(10, 10).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert [r[2] for r in sorted(got.rows)] == [10] * 10
+
+
+def test_run_then_run_and_wait_end_is_single_execution():
+    got = Gather()
+    p = (MultiPipe("dbl").add_source(source_of(stream_batches(1, 25)))
+         .add_sink(Sink_Builder(got).build()))
+    p.run()
+    p.run_and_wait_end()  # must wait, not re-run
+    assert len(got.rows) == 25
+
+
 def test_get_num_threads_keeps_pipe_open():
     got = Gather()
     p = (MultiPipe("x").add_source(source_of(stream_batches(1, 10)))

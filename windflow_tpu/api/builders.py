@@ -240,10 +240,14 @@ class WinSeq_Builder(_Builder, _WindowMixin):
         self._kw["winfunc"] = winfunc
 
 
-class WinFarm_Builder(_Builder, _WindowMixin, _WinParMixin):
-    """builders.hpp:803 — accepts a window function OR a Pane_Farm /
-    Win_MapReduce instance (nesting, Constructor III/IV of win_farm.hpp)."""
-    _pattern_cls = WinFarm
+class _NestingMixin:
+    """Shared nesting acceptance of WinFarm/KeyFarm builders: the input may
+    be a window function OR a Pane_Farm / Win_MapReduce instance
+    (Constructor III/IV of win_farm.hpp; initWindowConf,
+    builders.hpp:1210-1234).  Subclasses set `_nested_cls` and may override
+    `_nested_kw` to add routing etc."""
+
+    _nested_cls = None
 
     def __init__(self, input_):
         super().__init__()
@@ -254,61 +258,50 @@ class WinFarm_Builder(_Builder, _WindowMixin, _WinParMixin):
     def withOrdered(self, flag: bool = True):
         self._kw["ordered"] = flag
         return self
+
+    def _nested_kw(self) -> dict:
+        return dict(pardegree=self._kw.get("pardegree", 2),
+                    ordered=self._kw.get("ordered", True),
+                    name=self._kw.get("name",
+                                      self._nested_cls.__name__.lower()))
+
+    def build(self):
+        if isinstance(self._input, (PaneFarm, WinMapReduce)):
+            return self._nested_cls(self._input, **self._nested_kw())
+        return _Builder.build(self)
+
+    build_ptr = build
+    build_unique = build
+
+
+class WinFarm_Builder(_NestingMixin, _Builder, _WindowMixin, _WinParMixin):
+    """builders.hpp:803."""
+    _pattern_cls = WinFarm
+    _nested_cls = WinFarmOf
 
     def withEmitters(self, n: int):
         self._kw["n_emitters"] = int(n)
         return self
 
-    def build(self):
-        if isinstance(self._input, (PaneFarm, WinMapReduce)):
-            return WinFarmOf(self._input,
-                             pardegree=self._kw.get("pardegree", 2),
-                             ordered=self._kw.get("ordered", True),
-                             name=self._kw.get("name", "wf_nested"))
-        return super().build()
 
-    build_ptr = build
-    build_unique = build
-
-
-class KeyFarm_Builder(_Builder, _WindowMixin, _WinParMixin):
-    """builders.hpp:1193 — same nesting acceptance as WinFarm_Builder
-    (initWindowConf, builders.hpp:1210-1234)."""
+class KeyFarm_Builder(_NestingMixin, _Builder, _WindowMixin, _WinParMixin):
+    """builders.hpp:1193."""
     _pattern_cls = KeyFarm
-
-    def __init__(self, input_):
-        super().__init__()
-        self._input = input_
-        if not isinstance(input_, (PaneFarm, WinMapReduce)):
-            self._kw["winfunc"] = input_
+    _nested_cls = KeyFarmOf
 
     def withRouting(self, routing):
         self._kw["routing"] = routing
         return self
 
-    def withOrdered(self, flag: bool = True):
-        """Ordering of the nested collector (used by the Pane_Farm /
-        Win_MapReduce nesting form; plain Key_Farm workers are
-        per-key-ordered by construction)."""
-        self._kw["ordered"] = flag
-        return self
-
-    def build(self):
-        if isinstance(self._input, (PaneFarm, WinMapReduce)):
-            return KeyFarmOf(self._input,
-                             pardegree=self._kw.get("pardegree", 2),
-                             routing=self._kw.get("routing"),
-                             ordered=self._kw.get("ordered", True),
-                             name=self._kw.get("name", "kf_nested"))
-        return super().build()
+    def _nested_kw(self):
+        kw = super()._nested_kw()
+        kw["routing"] = self._kw.get("routing")
+        return kw
 
     def _build_kw(self):
         kw = dict(self._kw)
-        kw.pop("ordered", None)  # nesting-only option (see withOrdered)
+        kw.pop("ordered", None)  # plain Key_Farm workers are per-key-ordered
         return kw
-
-    build_ptr = build
-    build_unique = build
 
 
 class _TwoStageParMixin:

@@ -182,11 +182,24 @@ class MultiPipe:
             cls = type(p).__name__
             if cls in ("Filter", "FlatMap"):
                 dense = False  # rows dropped / multiplied
-            if getattr(p, "parallelism", 1) > 1 and not _is_keyed(p):
-                # non-keyed parallel stage (parallel sources included):
-                # the collector interleaves replica outputs
-                ordered = False
+            if cls == "Accumulator":
+                # accumulator snapshots carry the triggering row's header,
+                # but the fold makes ids non-window-meaningful downstream
+                dense = False
         return ordered, dense
+
+    @staticmethod
+    def _keeps_channels(group):
+        """True when the group's replica outputs must stay as separate
+        tails instead of being funnelled through a blind Collector: each
+        worker's output IS per-key ordered, but an interleaving collector
+        would destroy that invariant for good.  Downstream consumers either
+        don't care (stateless ops), or get a real k-way OrderingNode merge
+        over the per-replica channels — the reference's fused
+        OrderingNode∘worker combs (multipipe.hpp:218-224)."""
+        return all(_window_spec(p) is None and not _is_keyed(p)
+                   and not _is_composite(p) for p in group) \
+            and group[0].parallelism > 1
 
     def _build_into(self, df: Dataflow):
         tails = []
@@ -199,7 +212,10 @@ class MultiPipe:
             pattern = group[0] if len(group) == 1 else _FusedPattern(group)
             tails, ordered, dense = self._maybe_order(
                 df, tails, group, ordered, dense)
-            tails = add_farm(df, pattern, tails)
+            if self._keeps_channels(group):
+                tails = add_farm(df, pattern, tails, collector=None)
+            else:
+                tails = add_farm(df, pattern, tails)
             ordered, dense = self._stream_effect(group, ordered, dense)
         return tails
 
@@ -222,7 +238,11 @@ class MultiPipe:
         self._df.wait()
 
     def run_and_wait_end(self):
-        self._build().run_and_wait_end()
+        df = self._build()
+        if df._threads:          # already started via run(): just wait
+            df.wait()
+        else:
+            df.run_and_wait_end()
 
     def getNumThreads(self) -> int:
         """Thread count of the materialised graph (multipipe.hpp:973).

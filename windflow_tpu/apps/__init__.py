@@ -1,0 +1,1 @@
+"""Benchmark applications (the reference's src/ application suites)."""

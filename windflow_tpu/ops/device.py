@@ -121,6 +121,10 @@ class DeviceWindowExecutor:
 
     # ----------------------------------------------------------- compilation
 
+    def _pallas_key(self, pad, N):
+        return ("pallas", self.op, self.fields[0] if self.fields else None,
+                self.device.platform, pad, N)
+
     def _compiled(self, B, pad, N):
         # the jitted callable closes over (pad, N) only; B varies through the
         # argument shapes, which jax.jit re-specialises on by itself.  Keyed
@@ -130,8 +134,7 @@ class DeviceWindowExecutor:
         if self.use_pallas and self.device.platform in _PALLAS_BROKEN:
             self.use_pallas = False
         if self.use_pallas and self.op is not None and self.fields:
-            key = ("pallas", self.op, self.fields[0],
-                   self.device.platform, pad, N)
+            key = self._pallas_key(pad, N)
         else:
             key = (self.batch_fn, pad, N)
         fn = self._jits.get(key)
@@ -233,9 +236,7 @@ class DeviceWindowExecutor:
             # which on a v5e measures >1e9 windows/s anyway.  Evict the
             # failing entry and mark the platform so later executors skip
             # straight to the gather path.
-            _JIT_CACHE.pop(("pallas", self.op,
-                            self.fields[0] if self.fields else None,
-                            self.device.platform, pad, Nb), None)
+            _JIT_CACHE.pop(self._pallas_key(pad, Nb), None)
             _PALLAS_BROKEN.add(self.device.platform)
             self.use_pallas = False
             if not getattr(self.batch_fn, "_windflow_shared", False):

@@ -334,6 +334,10 @@ class _AccumulatorNode(Node):
                 self._keys[key] = acc
             self.fn(row, acc, *args)
             out[i] = acc  # emit a copy of the running result
+        # each snapshot carries the header of the row that triggered it
+        # (per-key ts order is preserved for downstream consumers)
+        for f in ("id", "ts"):
+            out[f] = batch[f]
         self.emit(out)
 
 

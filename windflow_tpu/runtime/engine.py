@@ -99,6 +99,9 @@ class Dataflow:
                 inbox.put_eos(src)
 
     def run(self):
+        if self._threads:
+            raise RuntimeError(
+                f"Dataflow {self.name!r} already started; a graph runs once")
         for node in self.nodes:
             t = threading.Thread(target=self._run_node, args=(node,),
                                  name=f"{self.name}/{node.name}", daemon=True)
