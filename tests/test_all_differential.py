@@ -1,0 +1,140 @@
+"""The full test_all differential harness — the port of the reference's
+``src/sum_test_cpu/test_all_cb.cpp`` / ``test_all_tb.cpp`` (and the GPU
+mirrors): run Win_Seq first on a deterministic stream to obtain the
+reference totals, then run EVERY farm / nesting / device composition with
+**randomized parallelism degrees** on the *same* stream and assert equal
+totals — plus the per-key in-order delivery counter the reference's
+Consumer asserts (``check_counters[key] == id``, sum_cb.hpp:146-150)."""
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.patterns.key_farm import KeyFarm
+from windflow_tpu.patterns.nesting import KeyFarmOf, WinFarmOf
+from windflow_tpu.patterns.pane_farm import PaneFarm
+from windflow_tpu.patterns.win_farm import WinFarm
+from windflow_tpu.patterns.win_mapreduce import WinMapReduce
+from windflow_tpu.patterns.win_seq import WinSeq
+from windflow_tpu.patterns.win_seq_tpu import (KeyFarmTPU, PaneFarmTPU,
+                                               WinFarmTPU, WinMapReduceTPU,
+                                               WinSeqTPU)
+
+from test_farms import cb_stream_batches, tb_stream_batches, run_windowed
+
+KEYS, N = 3, 140
+WIN, SLIDE = 12, 4          # sliding (pane-decomposable: slide < win)
+RNG = np.random.default_rng(20260729)
+
+
+def rand_deg(lo=2, hi=4):
+    """Randomized parallelism degrees, re-drawn per composition — the
+    mt19937 re-draws of test_pipe_*.cpp:233-264."""
+    return int(RNG.integers(lo, hi + 1))
+
+
+def stream(wt):
+    return (cb_stream_batches(KEYS, N) if wt is WinType.CB
+            else tb_stream_batches(KEYS, N))
+
+
+def total_of(per_key):
+    return sum(v for rs in per_key.values() for _, _, v in rs)
+
+
+def assert_in_order(per_key):
+    """Per-key result ids must arrive consecutively from their first id
+    (the Consumer's check_counters assertion)."""
+    for key, rs in per_key.items():
+        ids = [i for i, _, _ in rs]
+        assert ids == sorted(ids), f"key {key} results out of order"
+
+
+def compositions(wt, inc):
+    """Every composition of the test_all matrix, degrees re-drawn each
+    call.  `inc`: incremental (INC) vs non-incremental (NIC) user function
+    — Reducer serves as both, like the reference's sum functors."""
+    w, s = WIN, SLIDE
+    red = lambda: Reducer("sum")
+    kw = dict(incremental=inc) if inc is not None else {}
+
+    def pf(ordered=True):
+        return PaneFarm(red(), red(), w, s, wt, plq_degree=rand_deg(),
+                        wlq_degree=rand_deg(),
+                        plq_incremental=inc, wlq_incremental=inc)
+
+    def wmr(ordered=True):
+        return WinMapReduce(red(), red(), w, s, wt, map_degree=rand_deg(),
+                            reduce_degree=rand_deg(2, 2),
+                            map_incremental=inc, reduce_incremental=inc)
+
+    return {
+        "wf": WinFarm(red(), w, s, wt, pardegree=rand_deg(), **kw),
+        "kf": KeyFarm(red(), w, s, wt, pardegree=rand_deg(), **kw),
+        "pf": pf(),
+        "wmr": wmr(),
+        "wf+pf": WinFarmOf(pf(), pardegree=2),
+        "wf+wmr": WinFarmOf(wmr(), pardegree=2),
+        "kf+pf": KeyFarmOf(pf(), pardegree=rand_deg()),
+        "kf+wmr": KeyFarmOf(wmr(), pardegree=rand_deg()),
+    }
+
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB],
+                         ids=["cb", "tb"])
+@pytest.mark.parametrize("inc", [False, True], ids=["nic", "inc"])
+def test_all_host_compositions(wt, inc):
+    ref = run_windowed(WinSeq(Reducer("sum"), WIN, SLIDE, wt,
+                              incremental=inc), stream(wt))
+    assert_in_order(ref)
+    ref_total = total_of(ref)
+    assert ref_total > 0
+    for name, comp in compositions(wt, inc).items():
+        got = run_windowed(comp, stream(wt))
+        assert total_of(got) == ref_total, f"{name} total mismatch"
+        if getattr(comp, "ordered", True):
+            assert_in_order(got)
+
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB],
+                         ids=["cb", "tb"])
+def test_all_device_compositions(wt):
+    """The sum_test_gpu test_all mirror: every device-batched composition
+    equals the host Win_Seq on the same stream."""
+    ref_total = total_of(run_windowed(
+        WinSeq(Reducer("sum"), WIN, SLIDE, wt), stream(wt)))
+    device = {
+        "seq_tpu": WinSeqTPU(Reducer("sum"), WIN, SLIDE, wt, batch_len=32),
+        "wf_tpu": WinFarmTPU(Reducer("sum"), WIN, SLIDE, wt,
+                             pardegree=rand_deg(), batch_len=32),
+        "kf_tpu": KeyFarmTPU(Reducer("sum"), WIN, SLIDE, wt,
+                             pardegree=rand_deg(), batch_len=32),
+        "pf_tpu_plq": PaneFarmTPU(Reducer("sum"), Reducer("sum"), WIN, SLIDE,
+                                  wt, plq_degree=rand_deg(), wlq_degree=2,
+                                  wlq_on_device=False, batch_len=32),
+        "pf_tpu_wlq": PaneFarmTPU(Reducer("sum"), Reducer("sum"), WIN, SLIDE,
+                                  wt, plq_degree=2, wlq_degree=rand_deg(),
+                                  plq_on_device=False, batch_len=32),
+        "wmr_tpu_map": WinMapReduceTPU(Reducer("sum"), Reducer("sum"), WIN,
+                                       SLIDE, wt, map_degree=rand_deg(),
+                                       batch_len=32),
+        "wmr_tpu_red": WinMapReduceTPU(Reducer("sum"), Reducer("sum"), WIN,
+                                       SLIDE, wt, map_degree=2,
+                                       map_on_device=False,
+                                       reduce_on_device=True, batch_len=32),
+    }
+    for name, comp in device.items():
+        got = run_windowed(comp, stream(wt))
+        assert total_of(got) == ref_total, f"{name} total mismatch"
+
+
+def test_all_repeated_draws_stable():
+    """Re-drawing degrees (the -r flag loop of the reference harness) keeps
+    totals identical across 3 rounds."""
+    ref_total = total_of(run_windowed(
+        WinSeq(Reducer("sum"), WIN, SLIDE, WinType.CB), stream(WinType.CB)))
+    for _ in range(3):
+        for name, comp in compositions(WinType.CB, None).items():
+            got = run_windowed(comp, stream(WinType.CB))
+            assert total_of(got) == ref_total, name

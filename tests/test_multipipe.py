@@ -349,6 +349,37 @@ def test_cb_window_after_accumulator_renumbers():
     assert [r[2] for r in sorted(got.rows)] == [10] * 10
 
 
+def test_parallel_source_then_serial_stage_then_window():
+    """Regression: a parallelism-1 stage between kept replica channels and
+    the window must not blindly merge them — a TS merge is interposed so
+    the CB window still sees every tuple exactly once."""
+    per_replica = [stream_batches(1, 48, id0=48 * i) for i in range(2)]
+    got = Gather()
+    (MultiPipe("psm")
+     .add_source(Source_Builder().withBatches(lambda i: per_replica[i])
+                 .withSchema(SCHEMA).withParallelism(2).build())
+     .add(Map_Builder(lambda b: b.__setitem__("value", b["value"]))
+          .vectorized().build())
+     .add(WinSeq_Builder(Reducer("count")).withCBWindow(8, 8).build())
+     .add_sink(Sink_Builder(got).build())).run_and_wait_end()
+    assert got.total == 96
+    assert len(got.rows) == 12
+
+
+def test_failing_sink_propagates_instead_of_hanging():
+    """Regression: with bounded queues, a raising node used to deadlock
+    producers on its full inbox; the error must surface from
+    run_and_wait_end within bounded time."""
+    def bad_sink(row):
+        raise ValueError("boom")
+
+    p = (MultiPipe("err")
+         .add_source(source_of(stream_batches(1, 5000, chunk=8)))
+         .add_sink(Sink_Builder(bad_sink).build()))
+    with pytest.raises(ValueError, match="boom"):
+        p.run_and_wait_end()
+
+
 def test_run_then_run_and_wait_end_is_single_execution():
     got = Gather()
     p = (MultiPipe("dbl").add_source(source_of(stream_batches(1, 25)))

@@ -1,0 +1,99 @@
+"""Spatial (skyline) + microbenchmark suites — src/spatial_test and
+src/microbenchmarks analogs: a heavy NIC window function through WF / PF /
+WF(PF), differentially checked; the micro pipeline's counters."""
+
+import numpy as np
+import pytest
+
+from windflow_tpu.apps.micro import run as micro_run
+from windflow_tpu.apps.spatial import (POINT_SCHEMA, SkylinePLQ,
+                                       SkylineWindow, SkylineWLQ,
+                                       point_batches, skyline, skyline_mask)
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.patterns.nesting import WinFarmOf
+from windflow_tpu.patterns.pane_farm import PaneFarm
+from windflow_tpu.patterns.win_farm import WinFarm
+from windflow_tpu.patterns.win_seq import WinSeq
+from windflow_tpu.patterns.basic import Sink, Source
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import build_pipeline
+
+WIN, SLIDE = 200, 50   # ts units; sliding TB windows
+
+
+def run_spatial(pattern, batches):
+    got = {}
+
+    def snk(row):
+        if row is not None:
+            got.setdefault(int(row["key"]), []).append(
+                (int(row["id"]), int(row["size"]),
+                 round(float(row["checksum"]), 6)))
+
+    df = Dataflow()
+    build_pipeline(df, [Source(batches=batches, schema=POINT_SCHEMA),
+                        pattern, Sink(snk)])
+    df.run_and_wait_end()
+    return got
+
+
+# ------------------------------------------------------------ skyline kernel
+
+def test_skyline_mask_basic():
+    pts = np.array([[1.0, 1.0], [2.0, 2.0], [0.5, 3.0], [3.0, 0.5]])
+    mask = skyline_mask(pts)
+    # (2,2) dominated by (1,1); the rest are pareto-optimal
+    assert mask.tolist() == [True, False, True, True]
+
+
+def test_skyline_decomposability():
+    rng = np.random.default_rng(1)
+    pts = rng.uniform(0, 10, (500, 2))
+    direct = skyline(pts)
+    merged = skyline(np.concatenate([skyline(pts[:250]),
+                                     skyline(pts[250:])]))
+    assert sorted(map(tuple, direct)) == sorted(map(tuple, merged))
+
+
+# ----------------------------------------------------- pattern differentials
+
+@pytest.fixture(scope="module")
+def ref_results():
+    batches = point_batches(300, keys=2)
+    return run_spatial(WinSeq(SkylineWindow(), WIN, SLIDE, WinType.TB),
+                       batches), batches
+
+
+def test_spatial_win_farm(ref_results):
+    ref, batches = ref_results
+    got = run_spatial(WinFarm(SkylineWindow(), WIN, SLIDE, WinType.TB,
+                              pardegree=3), batches)
+    assert got == ref
+
+
+def test_spatial_pane_farm(ref_results):
+    """PLQ pane-skylines (object-valued results) merged by the WLQ give the
+    same skylines as the monolithic evaluation."""
+    ref, batches = ref_results
+    got = run_spatial(
+        PaneFarm(SkylinePLQ(), SkylineWLQ(), WIN, SLIDE, WinType.TB,
+                 plq_degree=2, wlq_degree=2), batches)
+    assert got == ref
+
+
+def test_spatial_nested_wf_of_pf(ref_results):
+    ref, batches = ref_results
+    inner = PaneFarm(SkylinePLQ(), SkylineWLQ(), WIN, SLIDE, WinType.TB,
+                     plq_degree=2, wlq_degree=1)
+    got = run_spatial(WinFarmOf(inner, pardegree=2), batches)
+    assert got == ref
+
+
+# -------------------------------------------------------------------- micro
+
+def test_micro_pipeline_counters():
+    m = micro_run(duration_sec=0.3, chunk=4096)
+    assert m["sent"] > 0
+    # Filter keeps value*3 even <=> original value even: exactly half
+    assert m["received"] == m["sent"] // 2
+    assert m["avg_latency_us"] >= 0

@@ -109,12 +109,15 @@ def test_ysb_last_update_is_window_max_ts():
     for c, w, t in zip(cmp_ids, wins, ts):
         want_max[(int(c), int(w))] = max(want_max.get((int(c), int(w)), 0),
                                          int(t))
-    got_max = {}
+    # per-campaign multisets must pair up, not just the global multiset
+    want_by_key = {}
+    for (c, _), t in want_max.items():
+        want_by_key.setdefault(c, []).append(t)
+    got_by_key = {}
     for k, _, lu in got.rows:
-        got_max.setdefault(k, []).append(lu)
-    all_want = sorted(want_max.values())
-    all_got = sorted(lu for _, _, lu in got.rows)
-    assert all_got == all_want
+        got_by_key.setdefault(k, []).append(lu)
+    assert {k: sorted(v) for k, v in got_by_key.items()} == \
+        {k: sorted(v) for k, v in want_by_key.items()}
 
 
 def test_ysb_aggregate_batch_matches_scalar():

@@ -159,6 +159,13 @@ class MultiPipe:
             mode = OrderingMode.TS_RENUMBERING
         elif sensitive and disordered:
             mode = OrderingMode.TS
+        elif len(tails) > 1 and not self._keeps_channels(group):
+            # a non-sensitive consumer would still merge the channels
+            # blindly at its (multi-in) emitter/replica inbox, destroying
+            # the per-channel order for everything downstream — merge here
+            # (the reference interposes OrderingNode at every Case-2
+            # shuffle, multipipe.hpp:218-224)
+            mode = OrderingMode.TS
         else:
             return tails, ordered, dense
         onode = OrderingNode(max(len(tails), 1), mode,
@@ -196,10 +203,21 @@ class MultiPipe:
         would destroy that invariant for good.  Downstream consumers either
         don't care (stateless ops), or get a real k-way OrderingNode merge
         over the per-replica channels — the reference's fused
-        OrderingNode∘worker combs (multipipe.hpp:218-224)."""
-        return all(_window_spec(p) is None and not _is_keyed(p)
-                   and not _is_composite(p) for p in group) \
-            and group[0].parallelism > 1
+        OrderingNode∘worker combs (multipipe.hpp:218-224).
+
+        Applies to non-keyed parallel stateless groups and to explicitly
+        unordered window farms (whose plain Collector would interleave the
+        per-worker result streams)."""
+        if any(_is_composite(p) or _is_keyed(p) for p in group):
+            return False
+        if group[0].parallelism <= 1:
+            return False
+        if all(_window_spec(p) is None for p in group):
+            return True
+        # single unordered window farm: drop its interleaving Collector
+        return (len(group) == 1
+                and _window_spec(group[0]) is not None
+                and not getattr(group[0], "ordered", True))
 
     def _build_into(self, df: Dataflow):
         tails = []

@@ -1,0 +1,103 @@
+"""Spatial queries over time-based windows — the port of the reference's
+``src/spatial_test`` suite (skytree.hpp skyline operator, sq_generator.hpp,
+test_spatial_{wf,pf,wf+pf}.cpp): a *heavy* non-incremental window function
+(skyline / pareto frontier, ms-scale per window) exercised through Win_Farm,
+Pane_Farm and the nested WF(PF) composition.
+
+The skyline is decomposable — ``skyline(A ∪ B) = skyline(skyline(A) ∪
+skyline(B))`` — which is exactly what Pane_Farm exploits: the PLQ computes
+per-pane skylines (carried as an object-dtype payload column, the analog of
+the reference's container-valued ``result_t``), and the WLQ merges pane
+skylines per window.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tuples import Schema
+from ..ops.functions import WindowFunction
+
+#: input stream schema: one d=2 point per tuple
+POINT_SCHEMA = Schema(x=np.float64, y=np.float64)
+
+#: full-result fields: skyline cardinality + coordinate checksum
+RESULT_FIELDS = {"size": np.int64, "checksum": np.float64}
+
+
+def skyline_mask(pts: np.ndarray) -> np.ndarray:
+    """Boolean mask of non-dominated points (minimisation in all dims).
+    O(n^2) dominance test, vectorised; `pts` is (n, d)."""
+    if len(pts) == 0:
+        return np.zeros(0, dtype=bool)
+    # a dominates b  <=>  all(a <= b) and any(a < b)
+    le = np.all(pts[None, :, :] <= pts[:, None, :], axis=2)   # le[i,j]: j<=i
+    lt = np.any(pts[None, :, :] < pts[:, None, :], axis=2)
+    dominated = np.any(le & lt, axis=1)
+    return ~dominated
+
+
+def skyline(pts: np.ndarray) -> np.ndarray:
+    return pts[skyline_mask(pts)]
+
+
+class SkylineWindow(WindowFunction):
+    """NIC window function: full skyline of the window's points
+    (the skytree.hpp operator's role in test_spatial_wf.cpp)."""
+
+    result_fields = RESULT_FIELDS
+    required_fields = ("x", "y")
+
+    def apply(self, key, gwid, rows):
+        pts = np.stack([rows["x"], rows["y"]], axis=1) if len(rows) \
+            else np.zeros((0, 2))
+        sk = skyline(pts)
+        return (len(sk), float(sk.sum()))
+
+
+class SkylinePLQ(WindowFunction):
+    """Pane stage: per-pane skyline carried as an object payload (the
+    container-valued result the reference expresses with an arbitrary C++
+    result_t)."""
+
+    result_fields = {"pts": np.dtype(object)}
+    required_fields = ("x", "y")
+
+    def apply(self, key, gwid, rows):
+        pts = np.stack([rows["x"], rows["y"]], axis=1) if len(rows) \
+            else np.zeros((0, 2))
+        return (skyline(pts),)
+
+
+class SkylineWLQ(WindowFunction):
+    """Window stage: merge the pane skylines of one window."""
+
+    result_fields = RESULT_FIELDS
+    required_fields = ("pts",)
+
+    def apply(self, key, gwid, rows):
+        parts = [p for p in rows["pts"] if p is not None and len(p)]
+        pts = np.concatenate(parts) if parts else np.zeros((0, 2))
+        sk = skyline(pts)
+        return (len(sk), float(sk.sum()))
+
+
+def point_batches(n_points, keys=1, chunk=512, seed=7, ts_step=5):
+    """Synthetic point stream (sq_generator.hpp analog): uniform points
+    with a linear timestamp ramp per key."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for lo in range(0, n_points, chunk):
+        m = min(chunk, n_points - lo)
+        ids = np.repeat(np.arange(lo, lo + m), keys)
+        ks = np.tile(np.arange(keys), m)
+        out.append(_pt_batch(ids, ks, ids * ts_step,
+                             rng.uniform(0, 100, m * keys),
+                             rng.uniform(0, 100, m * keys)))
+    return out
+
+
+def _pt_batch(ids, keys, ts, x, y):
+    from ..core.tuples import batch_from_columns
+    return batch_from_columns(POINT_SCHEMA, key=keys, id=ids, ts=ts,
+                              x=x, y=y)

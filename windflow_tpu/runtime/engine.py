@@ -22,26 +22,43 @@ from .node import Node, RuntimeContext, SourceNode
 _EOS = object()
 
 
-class Inbox:
-    """MPSC channel carrying (src_slot, batch) pairs."""
+class _Cancelled(BaseException):
+    """Raised inside a node thread when the dataflow failed elsewhere —
+    unblocks producers stuck on a dead consumer's bounded queue."""
 
-    def __init__(self, capacity: int = 0):
+
+class Inbox:
+    """MPSC channel carrying (src_slot, batch) pairs.  Blocking operations
+    poll the dataflow's failure flag so a raised node cannot deadlock the
+    graph (a full queue whose consumer died would block producers
+    forever)."""
+
+    def __init__(self, capacity: int = 0, failed: threading.Event = None):
         self._q = queue.Queue(maxsize=capacity)
         self.n_sources = 0
+        self._failed = failed
 
     def register_source(self) -> int:
         slot = self.n_sources
         self.n_sources += 1
         return slot
 
+    def _blocking(self, op):
+        while True:
+            try:
+                return op()
+            except (queue.Full, queue.Empty):
+                if self._failed is not None and self._failed.is_set():
+                    raise _Cancelled() from None
+
     def put(self, src: int, item):
-        self._q.put((src, item))
+        self._blocking(lambda: self._q.put((src, item), timeout=0.05))
 
     def put_eos(self, src: int):
-        self._q.put((src, _EOS))
+        self._blocking(lambda: self._q.put((src, _EOS), timeout=0.05))
 
     def get(self):
-        return self._q.get()
+        return self._blocking(lambda: self._q.get(timeout=0.05))
 
 
 class Dataflow:
@@ -49,19 +66,25 @@ class Dataflow:
     (MultiPipe::run_and_wait_end spawns cardinality()-1 threads,
     multipipe.hpp:1010; same model here)."""
 
-    def __init__(self, name: str = "dataflow"):
+    def __init__(self, name: str = "dataflow", capacity: int = 16):
+        # bounded inboxes give natural backpressure (FastFlow's
+        # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
+        # run unboundedly ahead of a slow consumer, keeping queue latency
+        # proportional to capacity x batch size.  0 = unbounded.
         self.name = name
+        self.capacity = capacity
         self.nodes: list[Node] = []
         self._inboxes: dict[int, Inbox] = {}
         self._edges: list[tuple[Node, Node]] = []
         self._threads: list[threading.Thread] = []
         self._errors: list[BaseException] = []
+        self._failed = threading.Event()
 
     def add(self, node: Node, ctx: RuntimeContext = None) -> Node:
         if ctx is not None:
             node.ctx = ctx
         self.nodes.append(node)
-        self._inboxes[id(node)] = Inbox()
+        self._inboxes[id(node)] = Inbox(self.capacity, self._failed)
         return node
 
     def connect(self, src: Node, dst: Node):
@@ -92,11 +115,17 @@ class Dataflow:
                         node.svc(item, src)
             node.eosnotify()
             node.svc_end()
+        except _Cancelled:
+            pass  # the graph failed elsewhere; exit quietly
         except BaseException as e:  # propagate to run_and_wait_end
             self._errors.append(e)
+            self._failed.set()  # unblock producers stuck on our inbox
         finally:
-            for inbox, src in node._outputs:
-                inbox.put_eos(src)
+            try:
+                for inbox, src in node._outputs:
+                    inbox.put_eos(src)
+            except _Cancelled:
+                pass
 
     def run(self):
         if self._threads:
