@@ -150,11 +150,11 @@ def test_ordering_core_kway_merge():
                             value=[0, 2])
     b2 = batch_from_columns(SCHEMA, key=[0, 0], id=[1, 3], ts=[1, 3],
                             value=[1, 3])
-    out1 = oc.push(b1, 0)      # channel-1 watermark is 0 -> only id 0 out
-    assert np.concatenate(out1)["id"].tolist() == [0]
-    out2 = oc.push(b2, 1)      # min watermark now 2 -> ids 1,2 released
+    out1 = oc.push(b1, 0)      # channel-1 watermark still -inf -> nothing
+    assert out1 == []
+    out2 = oc.push(b2, 1)      # min watermark now min(2,3)=2 -> 0,1,2 out
     released = np.concatenate(out2)["id"].tolist()
-    assert released == [1, 2]
+    assert released == [0, 1, 2]
     rest = [r["id"][0] for r in oc.flush()]
     assert rest == [3]
 

@@ -389,6 +389,34 @@ def test_run_then_run_and_wait_end_is_single_execution():
     assert len(got.rows) == 25
 
 
+def test_ordering_streams_disjoint_keys():
+    """Regression: a key flowing on only one channel must be released as
+    that channel's watermark advances — not buffered until EOS."""
+    from windflow_tpu.runtime.ordering import OrderingCore, OrderingMode
+    core = OrderingCore(2, OrderingMode.TS)
+    b0 = stream_batches(1, 40)[0]           # key 0 on channel 0
+    b1 = stream_batches(1, 40)[0].copy()    # key 1 on channel 1
+    b1["key"] = 1
+    out = core.push(b0, 0)
+    out += core.push(b1, 1)
+    released = sum(len(o) for o in out)
+    assert released > 0, "disjoint-key streams stalled until EOS"
+    # everything still arrives exactly once after flush
+    released += sum(len(o) for o in core.flush())
+    assert released == 80
+
+
+def test_ordering_channel_eos_unblocks():
+    """A finished channel leaves the watermark min (orderingNode.hpp
+    eosnotify semantics)."""
+    from windflow_tpu.runtime.ordering import OrderingCore, OrderingMode
+    core = OrderingCore(2, OrderingMode.TS)
+    b = stream_batches(1, 40)[0]
+    assert sum(len(o) for o in core.push(b, 0)) == 0  # ch1 watermark -inf
+    out = core.channel_eos(1)
+    assert sum(len(o) for o in out) == 40
+
+
 def test_get_num_threads_keeps_pipe_open():
     got = Gather()
     p = (MultiPipe("x").add_source(source_of(stream_batches(1, 10)))

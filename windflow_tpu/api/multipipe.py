@@ -77,8 +77,9 @@ class MultiPipe:
     """Deferred-construction pipeline of patterns.  Instances are also the
     operands of :func:`union_multipipes`."""
 
-    def __init__(self, name: str = "pipe"):
+    def __init__(self, name: str = "pipe", trace_dir: str = None):
         self.name = name
+        self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -239,7 +240,7 @@ class MultiPipe:
 
     def _build(self) -> Dataflow:
         if self._df is None:
-            df = Dataflow(self.name)
+            df = Dataflow(self.name, trace_dir=self.trace_dir)
             self._build_into(df)
             self._df = df
         return self._df
@@ -268,7 +269,7 @@ class MultiPipe:
         stays open for further add()/chain() calls."""
         if self._df is not None:
             return self._df.cardinality()
-        df = Dataflow(self.name)
+        df = Dataflow(self.name, trace_dir=self.trace_dir)
         self._build_into(df)
         return df.cardinality()
 

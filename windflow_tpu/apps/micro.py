@@ -23,19 +23,24 @@ SCHEMA = Schema(value=np.int64)
 
 
 def run(duration_sec=5.0, chunk=16384, pardegree=1):
+    import threading
     sent = [0]
+    sent_lock = threading.Lock()
 
     def gen(shipper):
         t0 = time.monotonic()
         v0 = 0
+        n = 0
         while time.monotonic() - t0 < duration_sec:
             now_us = int(time.time() * 1e6)
             v = np.arange(v0, v0 + chunk, dtype=np.int64)
             shipper.push_batch(batch_from_columns(
                 SCHEMA, key=v % 16, id=v,
                 ts=np.full(chunk, now_us, dtype=np.int64), value=v))
-            sent[0] += chunk
+            n += chunk
             v0 += chunk
+        with sent_lock:  # replicas race on the shared counter
+            sent[0] += n
 
     def fm(batch, shipper):
         # 1-to-1 flatmap (the reference's shipper exercise)

@@ -150,6 +150,10 @@ class _OrderedWorkerNode(WinSeqNode):
         for merged in self.ordering.push(batch, channel):
             super().svc(merged)
 
+    def on_channel_eos(self, channel):
+        for merged in self.ordering.channel_eos(channel):
+            super().svc(merged)
+
     def eosnotify(self):
         for merged in self.ordering.flush():
             WinSeqNode.svc(self, merged)

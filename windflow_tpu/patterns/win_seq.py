@@ -21,11 +21,19 @@ class WinSeqNode(Node):
     def svc(self, batch, channel=0):
         out = self.core.process(batch)
         if len(out):
+            # triggering vs non-triggering split (win_seq.hpp:479-501)
+            if self.stats is not None:
+                self.stats.bump("windows_fired", len(out))
+                self.stats.bump("triggering_batches")
             self.emit(out)
+        elif self.stats is not None:
+            self.stats.bump("non_triggering_batches")
 
     def eosnotify(self):
         out = self.core.flush()
         if len(out):
+            if self.stats is not None:
+                self.stats.bump("windows_fired", len(out))
             self.emit(out)
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from time import perf_counter_ns as _pc_ns
 
 from .node import Node, RuntimeContext, SourceNode
 
@@ -66,13 +67,16 @@ class Dataflow:
     (MultiPipe::run_and_wait_end spawns cardinality()-1 threads,
     multipipe.hpp:1010; same model here)."""
 
-    def __init__(self, name: str = "dataflow", capacity: int = 16):
+    def __init__(self, name: str = "dataflow", capacity: int = 16,
+                 trace_dir: str = None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
         # proportional to capacity x batch size.  0 = unbounded.
+        from ..utils.tracing import default_trace_dir
         self.name = name
         self.capacity = capacity
+        self.trace_dir = trace_dir or default_trace_dir()
         self.nodes: list[Node] = []
         self._inboxes: dict[int, Inbox] = {}
         self._edges: list[tuple[Node, Node]] = []
@@ -100,21 +104,33 @@ class Dataflow:
     def _run_node(self, node: Node):
         try:
             node.n_input_channels = self._inboxes[id(node)].n_sources
+            if self.trace_dir:
+                from ..utils.tracing import NodeStats
+                # index disambiguates same-named nodes (two 'map.0' stages)
+                idx = self.nodes.index(node)
+                node.stats = NodeStats(f"{self.name}_{idx:02d}_{node.name}")
             node.svc_init()
             if isinstance(node, SourceNode):
                 node.generate()
             else:
                 inbox = self._inboxes[id(node)]
                 live = inbox.n_sources
+                stats = node.stats
                 while live > 0:
                     src, item = inbox.get()
                     if item is _EOS:
                         live -= 1
                         node.on_channel_eos(src)
-                    else:
+                    elif stats is None:
                         node.svc(item, src)
+                    else:
+                        t0 = _pc_ns()
+                        node.svc(item, src)
+                        stats.record_svc(len(item), _pc_ns() - t0)
             node.eosnotify()
             node.svc_end()
+            if node.stats is not None:
+                node.stats.write(self.trace_dir)
         except _Cancelled:
             pass  # the graph failed elsewhere; exit quietly
         except BaseException as e:  # propagate to run_and_wait_end

@@ -74,6 +74,8 @@ class Node:
         several outputs that need routing use emit_to)."""
         if batch is None:
             return
+        if self.stats is not None:
+            self.stats.record_departure()
         for inbox, src in self._outputs:
             inbox.put(src, batch)
 
@@ -81,6 +83,8 @@ class Node:
         """Send to one specific output channel (ff_send_out_to)."""
         if batch is None:
             return
+        if self.stats is not None:
+            self.stats.record_departure()
         inbox, src = self._outputs[out]
         inbox.put(src, batch)
 

@@ -37,25 +37,40 @@ class OrderingMode(enum.Enum):
 
 
 class _KeyBuf:
-    __slots__ = ("chans", "maxs", "marker_row", "marker_pos", "emit_counter")
+    __slots__ = ("chans", "marker_row", "marker_pos", "emit_counter")
 
     def __init__(self, n_channels):
         self.chans = [[] for _ in range(n_channels)]  # lists of row chunks
-        self.maxs = np.full(n_channels, 0, dtype=np.int64)
         self.marker_row = None
         self.marker_pos = _NEG_INF
         self.emit_counter = 0
 
+    def has_rows(self):
+        return any(self.chans)
+
 
 class OrderingCore:
     """Reusable merge engine (also fused in front of farm workers, the
-    ff_comb(OrderingNode, worker) analog, win_farm.hpp:157-162)."""
+    ff_comb(OrderingNode, worker) analog, win_farm.hpp:157-162).
+
+    Watermarks are per *channel* and global across keys, exactly like the
+    reference's ``maxs[]`` (orderingNode.hpp:151-162): a channel's watermark
+    is the greatest position it has delivered on ANY key, so a key flowing
+    on only one channel still advances (disjoint key ranges per producer
+    are the norm after keyed partitioning).  A channel that reaches EOS is
+    excluded from the min (its watermark jumps to +inf,
+    orderingNode.hpp:182-221) so the merge never stalls on finished
+    producers.  Assumes each channel delivers rows in globally
+    nondecreasing position order — true for every producer the runtime
+    wires (sources are monotone; workers process a monotone stream in
+    arrival order)."""
 
     def __init__(self, n_channels: int, mode: OrderingMode):
         self.n_channels = n_channels
         self.mode = mode
         self.pos_field = "id" if mode is OrderingMode.ID else "ts"
         self._keys: dict[int, _KeyBuf] = {}
+        self.watermark = np.full(n_channels, _NEG_INF, dtype=np.int64)
 
     def _buf(self, key):
         b = self._keys.get(key)
@@ -112,12 +127,29 @@ class OrderingCore:
             key = int(keys[grp[0]])
             kb = self._buf(key)
             rows = batch[grp]
-            kb.maxs[channel] = int(rows[self.pos_field][-1])
             kb.chans[channel].append(rows)
-            rel = self._release(kb, key, int(kb.maxs.min()))
+        wm = self.watermark
+        wm[channel] = max(int(wm[channel]),
+                          int(batch[self.pos_field].max()))
+        out.extend(self._release_all(int(wm.min())))
+        return out
+
+    def _release_all(self, upto: int):
+        """A watermark advance can release buffered rows of ANY key."""
+        out = []
+        for key, kb in self._keys.items():
+            if not kb.has_rows():
+                continue
+            rel = self._release(kb, key, upto)
             if rel is not None:
                 out.append(rel)
         return out
+
+    def channel_eos(self, channel: int):
+        """Exclude a finished channel from the watermark min and release
+        what that unblocks (orderingNode.hpp:182-221)."""
+        self.watermark[channel] = 2 ** 62
+        return self._release_all(int(self.watermark.min()))
 
     def flush(self):
         """EOS: release everything, then the per-key marker (renumbered too,
@@ -146,6 +178,10 @@ class OrderingNode(Node):
 
     def svc(self, batch, channel=0):
         for out in self.core.push(batch, channel):
+            self.emit(out)
+
+    def on_channel_eos(self, channel: int):
+        for out in self.core.channel_eos(channel):
             self.emit(out)
 
     def eosnotify(self):
