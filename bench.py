@@ -27,10 +27,11 @@ BASELINE_TUPLES_PER_SEC = 20e6
 
 # workload shape: CB sliding windows, the sum_test_gpu default regime
 N_KEYS = 64
-N_TUPLES = 4_000_000          # total stream length across keys
+N_TUPLES = 16_000_000         # total stream length across keys
 WIN, SLIDE = 256, 64
-BATCH_LEN = 2048              # fired windows per device launch
-CHUNK = 131072                # stream batch (rows per engine message)
+BATCH_LEN = 1 << 15           # fired-window flush trigger (row trigger first)
+FLUSH_ROWS = 1 << 20          # rows per fused device dispatch
+CHUNK = 1 << 20               # stream batch (rows per engine message)
 
 
 def make_stream(schema):
@@ -60,17 +61,17 @@ def run_once(batches, schema):
     n_out = [0]
     total = [0]
 
-    def consume(r):
-        if r is not None:
-            n_out[0] += 1
-            total[0] += int(r["value"])
+    def consume(rows):
+        if rows is not None and len(rows):
+            n_out[0] += len(rows)
+            total[0] += int(rows["value"].sum())
 
     df = Dataflow()
     build_pipeline(df, [
         Source(batches=batches, schema=schema),
         WinSeqTPU(Reducer("sum"), WIN, SLIDE, WinType.CB,
-                  batch_len=BATCH_LEN),
-        Sink(consume, vectorized=False)])
+                  batch_len=BATCH_LEN, flush_rows=FLUSH_ROWS, depth=8),
+        Sink(consume, vectorized=True)])
     t0 = time.perf_counter()
     df.run_and_wait_end()
     dt = time.perf_counter() - t0
@@ -122,7 +123,7 @@ def main():
     print(json.dumps({
         "metric": "sum_test_tpu CB windowed-sum input tuples/sec "
                   f"(win={WIN} slide={SLIDE} keys={N_KEYS} "
-                  f"batch_len={BATCH_LEN}, {n_windows} windows)",
+                  f"flush_rows={FLUSH_ROWS}, {n_windows} windows)",
         "value": round(tps, 1),
         "unit": "tuples/sec",
         "vs_baseline": round(tps / BASELINE_TUPLES_PER_SEC, 3),

@@ -1,0 +1,179 @@
+"""Differential tests for the device-resident window path (ops/resident.py +
+ResidentWinSeqCore): the resident core must produce byte-identical results to
+the host WinSeqCore on the same stream — the same invariant the reference's
+``src/sum_test_gpu/test_all_*.cpp`` asserts between CPU and GPU pattern
+variants, here asserted per-row rather than on totals."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.core.windows import PatternConfig, Role, WindowSpec, WinType
+from windflow_tpu.core.winseq import WinSeqCore
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.patterns.win_seq_tpu import (DeviceWinSeqCore,
+                                               ResidentWinSeqCore,
+                                               make_core_for)
+
+SCHEMA = Schema(value=np.int64)
+
+
+def run_core(core, batches):
+    outs = []
+    for b in batches:
+        r = core.process(b)
+        if len(r):
+            outs.append(r)
+    r = core.flush()
+    if len(r):
+        outs.append(r)
+    if not outs:
+        return np.zeros(0, dtype=core._result_dtype)
+    out = np.concatenate(outs)
+    return np.sort(out, order=["key", "id"])
+
+
+def cb_stream(n_keys, per_key, chunk=37, seed=0, lo_val=-50, hi_val=100):
+    rng = np.random.default_rng(seed)
+    batches = []
+    for lo in range(0, per_key, chunk):
+        m = min(chunk, per_key - lo)
+        ids = np.repeat(np.arange(lo, lo + m), n_keys)
+        keys = np.tile(np.arange(n_keys), m)
+        vals = rng.integers(lo_val, hi_val, size=m * n_keys).astype(np.int64)
+        batches.append(batch_from_columns(
+            SCHEMA, key=keys, id=ids, ts=ids, value=vals))
+    return batches
+
+
+def tb_stream(n_keys, per_key, seed=0):
+    rng = np.random.default_rng(seed)
+    ts_all = np.sort(rng.integers(0, per_key * 2, size=per_key))
+    batches = []
+    for lo in range(0, per_key, 53):
+        m = min(53, per_key - lo)
+        tss = np.repeat(ts_all[lo:lo + m], n_keys)
+        ids = np.repeat(np.arange(lo, lo + m), n_keys)
+        keys = np.tile(np.arange(n_keys), m)
+        vals = rng.integers(0, 100, size=m * n_keys).astype(np.int64)
+        batches.append(batch_from_columns(
+            SCHEMA, key=keys, id=ids, ts=tss, value=vals))
+    return batches
+
+
+def assert_equal_results(a, b):
+    assert len(a) == len(b)
+    for f in ("key", "id", "ts", "value"):
+        np.testing.assert_array_equal(a[f], b[f])
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+@pytest.mark.parametrize("win,slide", [(16, 4), (8, 8), (4, 12)])
+@pytest.mark.parametrize("n_keys", [1, 5])
+def test_resident_cb_matches_host(op, win, slide, n_keys):
+    batches = cb_stream(n_keys, 503, seed=win * 31 + slide)
+    spec = WindowSpec(win, slide, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer(op)), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = make_core_for(spec, Reducer(op), batch_len=64,
+                                 flush_rows=200)
+    assert isinstance(dev_core, ResidentWinSeqCore)
+    assert_equal_results(host, run_core(dev_core, batches))
+
+
+@pytest.mark.parametrize("op", ["sum", "max"])
+@pytest.mark.parametrize("win,slide", [(20, 5), (10, 10), (6, 16)])
+def test_resident_tb_matches_host(op, win, slide):
+    batches = tb_stream(3, 400, seed=win + slide)
+    spec = WindowSpec(win, slide, WinType.TB)
+    host = run_core(WinSeqCore(spec, Reducer(op)), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = make_core_for(spec, Reducer(op), batch_len=32,
+                                 flush_rows=150)
+    assert_equal_results(host, run_core(dev_core, batches))
+
+
+def test_resident_tiny_flush_forces_rebase():
+    """Aggressive flush thresholds force many ring rebases; results must
+    still match (exercises the deferred-purge + rebase invariant)."""
+    batches = cb_stream(4, 1000, chunk=29, seed=9)
+    spec = WindowSpec(32, 8, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = ResidentWinSeqCore(spec, Reducer("sum"), batch_len=16,
+                                      flush_rows=64)
+    assert_equal_results(host, run_core(dev_core, batches))
+
+
+def test_resident_plq_renumbering():
+    """PLQ role renumbers result ids (win_seq.hpp:396-405); the resident
+    path must renumber identically to the host core."""
+    batches = cb_stream(3, 300, seed=4)
+    spec = WindowSpec(8, 8, WinType.CB)
+    cfg = PatternConfig(0, 1, 8, 1, 2, 8)
+    host = run_core(
+        WinSeqCore(spec, Reducer("sum"), config=cfg, role=Role.PLQ), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = ResidentWinSeqCore(spec, Reducer("sum"), config=cfg,
+                                      role=Role.PLQ, batch_len=32,
+                                      flush_rows=100)
+    assert_equal_results(host, run_core(dev_core, batches))
+
+
+def test_resident_narrow_wire_dtypes():
+    """Values outside int8/int16 ranges must widen the wire dtype."""
+    batches = cb_stream(2, 256, seed=5, lo_val=-40000, hi_val=40000)
+    spec = WindowSpec(16, 4, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = ResidentWinSeqCore(spec, Reducer("sum"), batch_len=64,
+                                      flush_rows=300)
+    assert_equal_results(host, run_core(dev_core, batches))
+
+
+def test_resident_prod_matches_host():
+    """prod rides the masked gather-reduce branch; regression for pad=0
+    (which made every prod window return the identity)."""
+    batches = cb_stream(2, 120, chunk=17, seed=11, lo_val=1, hi_val=4)
+    spec = WindowSpec(6, 3, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer("prod")), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = make_core_for(spec, Reducer("prod"), batch_len=16,
+                                 flush_rows=60)
+    assert isinstance(dev_core, ResidentWinSeqCore)
+    assert_equal_results(host, run_core(dev_core, batches))
+
+
+def test_resident_float_sum_keeps_restaging_path():
+    """float32 cumsum accumulates rounding error over the ring, so float
+    sums must not auto-select the resident path."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(4, 2, WinType.CB),
+                             Reducer("sum", dtype=np.float32))
+    assert not isinstance(core, ResidentWinSeqCore)
+
+
+def test_resident_count_uses_legacy_path():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(4, 2, WinType.CB), Reducer("count"))
+    assert isinstance(core, DeviceWinSeqCore)
+    assert not isinstance(core, ResidentWinSeqCore)
+
+
+def test_resident_rejects_incremental():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = ResidentWinSeqCore(WindowSpec(4, 2, WinType.CB),
+                                  Reducer("sum"))
+    with pytest.raises(TypeError):
+        core.use_incremental()

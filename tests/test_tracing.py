@@ -43,8 +43,8 @@ def test_trace_files_written(tmp_path):
     assert sink["rcv_tuples"] == 10
 
 
-def test_no_trace_files_by_default(tmp_path):
-    os.environ.pop("WF_LOG_DIR", None)
+def test_no_trace_files_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("WF_LOG_DIR", raising=False)
     build().run_and_wait_end()
     assert not os.path.exists(str(tmp_path / "log"))
 

@@ -233,6 +233,7 @@ class WinSeqCore:
         # --- archive (NIC only, non-marker rows; win_seq.hpp:340) ---
         if self.is_nic and len(real):
             st.archive.append(real)
+            self._on_append(key, st, real)
         # --- window creation ---
         max_rel = int(rel.max())
         last_w = int(spec.last_win_containing(max_rel))
@@ -262,6 +263,10 @@ class WinSeqCore:
         lwids = np.arange(st.n_fired, n_fire_to, dtype=np.int64)
         st.n_fired = n_fire_to
         return self._emit_windows(key, st, lwids, eos=False)
+
+    def _on_append(self, key, st: _KeyState, rows: np.ndarray):
+        """Hook: called after `rows` are appended to `key`'s archive (the
+        device-resident core mirrors appends into the HBM archive here)."""
 
     def _emit_windows(self, key, st: _KeyState, lwids: np.ndarray, eos: bool):
         spec = self.spec

@@ -1,0 +1,226 @@
+"""Device-resident window archives: each stream row crosses the host→device
+wire ONCE and window evaluation reads HBM.
+
+This is the second-generation device path (the first, ``device.py``, restages
+every fired window's archive segment per batch, mirroring the reference's
+per-batch ``cudaMemcpyAsync`` of ``Bin`` — win_seq_gpu.hpp:451-476).  Measured
+on the tunneled v5e (see BASELINE.md), the wire — not the chip — is the
+budget: ~120 ms round-trip latency and ~50 MB/s host→device bandwidth, while
+on-device work (cumsum over the whole ring, (B, pad) gathers) is effectively
+free.  The design therefore:
+
+* keeps a per-key **ring archive** resident on the device: a ``(KP, cap)``
+  array whose row ``r`` holds the live tuples of dense-key ``r`` in arrival
+  order (the device twin of ``core/archive.py``'s host ``KeyArchive``);
+* appends each chunk's new rows as ONE rectangle in the **narrowest dtype**
+  that holds the chunk's value range (int8/int16/int32/float32), widened to
+  the accumulate dtype on device;
+* fuses append + window evaluation into ONE dispatch per launch: a vmapped
+  ``dynamic_update_slice`` writes the rectangle at per-key offsets, then
+  either a ring-wide ``cumsum`` + two-point gather (sum/mean — O(B) gathered
+  elements instead of O(B·win)) or a masked ``(B, pad)`` gather-reduce
+  (min/max) evaluates every fired window;
+* fetches results asynchronously (``copy_to_host_async``) with bounded
+  depth, so steady state pipelines H2D, compute, and D2H over the tunnel.
+
+The host side (``ResidentWinSeqCore`` in patterns/win_seq_tpu.py) owns all
+bookkeeping — write offsets, ring rebase, window descriptors — so this
+executor is a dumb, replayable launch queue, like the reference's per-worker
+``cudaStream_t`` (win_seq_gpu.hpp:294).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: process-wide compiled-step cache (executors are per-pattern-instance,
+#: the executables they compile should outlive them)
+_STEP_CACHE = {}
+
+_REDUCE_OPS = ("sum", "min", "max", "prod")
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _identity(op: str, dtype: np.dtype):
+    if op == "min":
+        return (np.iinfo(dtype).max if np.issubdtype(dtype, np.integer)
+                else np.inf)
+    if op == "max":
+        return (np.iinfo(dtype).min if np.issubdtype(dtype, np.integer)
+                else -np.inf)
+    return 1 if op == "prod" else 0
+
+
+def _make_step(key):
+    """Build + jit the fused append+eval step for one shape bucket."""
+    (op, cap, R, B, KP, blk_dt, acc_dt, pad) = key
+    blk_dt = np.dtype(blk_dt)
+    acc_dt = np.dtype(acc_dt)
+
+    def step(ring, blk, offs, wrows, wstarts, wlens):
+        blk = blk.astype(acc_dt)
+        ring = jax.vmap(
+            lambda row, b, o: lax.dynamic_update_slice(row, b, (o,))
+        )(ring, blk, offs)
+        if op == "sum":
+            cs = jnp.cumsum(ring, axis=1)
+            cs = jnp.pad(cs, ((0, 0), (1, 0)))
+            out = cs[wrows, wstarts + wlens] - cs[wrows, wstarts]
+        else:  # min/max/prod: masked gather-reduce over resident rows
+            idx = jnp.minimum(
+                wstarts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
+                cap - 1)
+            vals = ring[wrows[:, None], idx]
+            mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < wlens[:, None]
+            ident = jnp.asarray(_identity(op, acc_dt), dtype=acc_dt)
+            red = {"min": jnp.min, "max": jnp.max, "prod": jnp.prod}[op]
+            out = red(jnp.where(mask, vals, ident), axis=1)
+        return ring, out
+
+    return jax.jit(step)
+
+
+class ResidentWindowExecutor:
+    """Launch queue over a device-resident ring archive.
+
+    The caller fully specifies each dispatch (rectangle, offsets, window
+    descriptors in ring coordinates); this class handles shape bucketing,
+    dtype narrowing/widening, the ring array's lifetime, and asynchronous
+    result harvest.  ``op`` is one of sum/mean/min/max ("count" needs no
+    device work — the host core answers it from window lengths).
+    """
+
+    def __init__(self, op: str, device=None, depth: int = 8,
+                 acc_dtype=np.int32):
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"unsupported resident op {op!r}")
+        self.op = op
+        self.device = device or jax.devices()[0]
+        self.depth = depth
+        self.acc_dtype = np.dtype(acc_dtype)
+        self.cap = 0          # ring columns (set on first reset)
+        self.KP = 0           # ring rows (padded key count)
+        self._ring = None
+        self._inflight = deque()   # (meta, B, device_out)
+        self._ready = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def reset(self, n_keys: int, cap: int):
+        """(Re)allocate an empty ring of at least (n_keys, cap); contents
+        are repopulated by the next launch's rectangle (host rebase)."""
+        self.KP = _bucket(max(n_keys, 1))
+        self.cap = _bucket(max(cap, 16))
+        self._ring = None  # lazily zeros on next launch
+
+    def _ring_arr(self):
+        if self._ring is None:
+            self._ring = jax.device_put(
+                jnp.zeros((self.KP, self.cap), dtype=self.acc_dtype),
+                self.device)
+        return self._ring
+
+    # ------------------------------------------------------------- dispatch
+
+    @staticmethod
+    def narrow(vals: np.ndarray) -> np.dtype:
+        """Narrowest wire dtype holding `vals` exactly (ints narrow to
+        int8/int16/int32; floats ship as float32)."""
+        if vals.dtype.kind == "f":
+            return np.dtype(np.float32)
+        if not len(vals):
+            return np.dtype(np.int8)
+        lo, hi = int(vals.min()), int(vals.max())
+        for dt in (np.int8, np.int16, np.int32):
+            info = np.iinfo(dt)
+            if info.min <= lo and hi <= info.max:
+                return np.dtype(dt)
+        return np.dtype(np.int32)  # accumulate dtype ceiling (wraps warn
+        # upstream, matching device.py's int64→int32 policy)
+
+    def launch(self, meta, blk: np.ndarray, offs: np.ndarray,
+               wrows: np.ndarray, wstarts: np.ndarray, wlens: np.ndarray):
+        """One fused append+eval dispatch.
+
+        blk: (K, R) new rows per dense key (narrow dtype, zero-padded);
+        offs: (K,) per-key ring write offsets; wrows/wstarts/wlens: (B,)
+        fired-window descriptors in ring coordinates.  `meta` is returned
+        with the results at harvest.  Caller guarantees offs + R <= cap.
+        """
+        K, R = blk.shape
+        if K > self.KP:
+            raise ValueError("rectangle exceeds ring rows; reset() first")
+        B = len(wstarts)
+        Rb = _bucket(max(R, 1))
+        Bb = _bucket(max(B, 1))
+        if len(offs) and int(offs.max()) + Rb > self.cap:
+            # dynamic_update_slice clamps the start, which would silently
+            # overwrite live cells near the ring end — the host core's
+            # rebase invariant must prevent ever getting here
+            raise ValueError(
+                f"ring overflow: offset {int(offs.max())} + {Rb} > {self.cap}")
+        pad = (_bucket(int(wlens.max()) if B else 1)
+               if self.op != "sum" else 0)
+
+        def pad2(a, rows, cols):
+            out = np.zeros((rows, cols), dtype=a.dtype)
+            out[:a.shape[0], :a.shape[1]] = a
+            return out
+
+        def pad1(a, size, dtype=np.int32):
+            out = np.zeros(size, dtype=dtype)
+            out[:len(a)] = a
+            return out
+
+        key = (self.op, self.cap, Rb, Bb, self.KP, blk.dtype.str,
+               self.acc_dtype.str, pad)
+        fn = _STEP_CACHE.get(key)
+        if fn is None:
+            fn = _STEP_CACHE[key] = _make_step(key)
+        args = jax.device_put(
+            (pad2(blk, self.KP, Rb), pad1(offs, self.KP),
+             pad1(wrows, Bb), pad1(wstarts, Bb), pad1(wlens, Bb)),
+            self.device)
+        self._ring, out = fn(self._ring_arr(), *args)
+        getattr(out, "copy_to_host_async", lambda: None)()
+        self._inflight.append((meta, B, out))
+        while len(self._inflight) > self.depth:
+            self._harvest_one()
+
+    # -------------------------------------------------------------- harvest
+
+    def _harvest_one(self):
+        meta, B, out = self._inflight.popleft()
+        self._ready.append((meta, np.asarray(out)[:B]))
+
+    def poll(self):
+        """Harvest completed launches without blocking on the rest."""
+        while self._inflight and self._is_ready(self._inflight[0][2]):
+            self._harvest_one()
+        ready, self._ready = self._ready, []
+        return ready
+
+    @staticmethod
+    def _is_ready(out) -> bool:
+        try:
+            return out.is_ready()
+        except AttributeError:
+            return True
+
+    def drain(self):
+        while self._inflight:
+            self._harvest_one()
+        ready, self._ready = self._ready, []
+        return ready

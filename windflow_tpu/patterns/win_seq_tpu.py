@@ -186,12 +186,271 @@ class DeviceWinSeqCore(WinSeqCore):
                         "(win_seq_gpu.hpp supports NIC device functors)")
 
 
-def make_device_core(worker, fn, dev_kw) -> DeviceWinSeqCore:
+class ResidentWinSeqCore(WinSeqCore):
+    """Window core whose archive lives in device HBM (ops/resident.py).
+
+    Host-side it is the same Win_Seq bookkeeping as every other core; the
+    differences from :class:`DeviceWinSeqCore` (which restages each fired
+    window's rows per batch, like the reference's per-batch H2D memcpy,
+    win_seq_gpu.hpp:451-476) are:
+
+    * appended rows are mirrored once into the device ring archive, in the
+      narrowest dtype holding their range — each row crosses the wire once;
+    * fired windows are described by (ring row, start, len) only; append and
+      evaluation fuse into one dispatch per flush;
+    * the host archive's purge is deferred to flush time so a rebase (ring
+      compaction) can always rebuild the ring from host-live rows.
+    """
+
+    def __init__(self, spec: WindowSpec, reducer, batch_len: int = 8192,
+                 flush_rows: int = 1 << 20, config: PatternConfig = None,
+                 role: Role = Role.SEQ, map_indexes=(0, 1),
+                 result_ts_slide=None, device=None, depth: int = 8,
+                 compute_dtype=None):
+        from ..ops.resident import ResidentWindowExecutor, _identity
+        if not isinstance(reducer, Reducer):
+            raise TypeError("resident device path needs a builtin Reducer")
+        super().__init__(spec, reducer, config=config, role=role,
+                         map_indexes=map_indexes,
+                         result_ts_slide=result_ts_slide)
+        self.reducer = reducer
+        self.field = reducer.field
+        self.out_field = reducer.out_field
+        if compute_dtype is not None:
+            acc = np.dtype(compute_dtype)
+        elif np.issubdtype(reducer.dtype, np.floating):
+            acc = np.dtype(np.float32)
+        else:
+            acc = np.dtype(np.int32)
+        if reducer.dtype.itemsize > acc.itemsize:
+            import warnings
+            warnings.warn(
+                f"resident device path accumulates in {acc}; {reducer.op} "
+                f"results beyond its range will wrap — pass compute_dtype "
+                "for wide ranges", stacklevel=4)
+        self.executor = ResidentWindowExecutor(reducer.op, device=device,
+                                               depth=depth, acc_dtype=acc)
+        self.batch_len = batch_len
+        self.flush_rows = flush_rows
+        self._rowmap = {}     # key -> dense ring row
+        self._appended = {}   # key -> rows ever archived (abs row domain)
+        self._launched = {}   # key -> rows already shipped to the ring
+        self._base = {}       # key -> abs row index of ring column 0
+        self._pend_vals = {}  # key -> [value arrays not yet shipped]
+        self._pend_rows = 0
+        self._wdesc = []      # (key, abs_lo array, len array)
+        self._hdr = []        # (key, ids, ts, lens) per fire
+        self._n_wins = 0
+        self._purge_pos = {}  # key -> purge threshold deferred to flush
+
+    # ------------------------------------------------------------ bookkeeping
+
+    def _on_append(self, key, st, rows):
+        self._rowmap.setdefault(key, len(self._rowmap))
+        self._pend_vals.setdefault(key, []).append(
+            np.asarray(rows[self.field]))
+        self._appended[key] = self._appended.get(key, 0) + len(rows)
+        self._pend_rows += len(rows)
+        if self._pend_rows >= self.flush_rows:
+            self._flush_batch()
+
+    def _emit_windows(self, key, st, lwids, eos: bool):
+        spec = self.spec
+        self._rowmap.setdefault(key, len(self._rowmap))
+        gwids = st.first_gwid + lwids * self.config.gwid_stride()
+        ts = self._result_ts(st, lwids, gwids)
+        ids = self._renumber_ids(key, st, gwids)
+        starts_abs = spec.win_start(lwids) + st.initial_id
+        ends_abs = spec.win_end(lwids) + st.initial_id
+        p = st.archive.positions
+        lo = np.searchsorted(p, starts_abs, side="left")
+        hi = (np.full(len(lwids), len(p), dtype=np.int64) if eos
+              else np.searchsorted(p, ends_abs, side="left"))
+        live_start = self._appended.get(key, 0) - len(p)
+        self._wdesc.append((key, lo + live_start, (hi - lo).astype(np.int64)))
+        self._hdr.append((key, ids, ts, (hi - lo).astype(np.int64)))
+        self._n_wins += len(lwids)
+        if not eos and len(lwids):
+            # defer the purge so a flush-time rebase can rebuild the ring
+            # from host-live rows (win_seq.hpp:390-392 purges at fire time)
+            self._purge_pos[key] = max(self._purge_pos.get(key, -2 ** 62),
+                                       int(starts_abs[-1]))
+        if self._n_wins >= self.batch_len:
+            self._flush_batch()
+        return None
+
+    # ------------------------------------------------------------------ flush
+
+    def _flush_batch(self):
+        if not self._wdesc and not self._pend_rows:
+            return
+        from ..ops.resident import _bucket
+        ex = self.executor
+        rowmap = self._rowmap
+        K = len(rowmap)
+        # --- decide append vs rebase ---
+        rebase = ex.cap == 0 or ex.KP < _bucket(max(K, 1))
+        if not rebase:
+            # the append rectangle is (K, Rb) with one global padded width,
+            # so every key needs fill + Rb columns of room
+            maxpend = max((self._appended.get(key, 0)
+                           - self._launched.get(key, 0) for key in rowmap),
+                          default=0)
+            Rb = _bucket(max(maxpend, 1))
+            for key in rowmap:
+                fill = self._launched.get(key, 0) - self._base.get(key, 0)
+                if fill + Rb > ex.cap:
+                    rebase = True
+                    break
+        if rebase:
+            counts = {}
+            maxlive = 0
+            for key in rowmap:
+                st = self._keys.get(key)
+                counts[key] = len(st.archive) if st is not None else 0
+                maxlive = max(maxlive, counts[key])
+            per_key_slack = max(self.flush_rows // max(K, 1), 64)
+            ex.reset(K, _bucket(2 * maxlive + 2 * per_key_slack))
+            R = maxlive
+            srcs = {key: ([np.asarray(self._keys[key].archive.rows[self.field])]
+                          if key in self._keys else [])
+                    for key in rowmap}
+            for key in rowmap:
+                self._base[key] = self._appended.get(key, 0) - counts[key]
+                self._launched[key] = self._base[key]
+            offs = np.zeros(ex.KP, dtype=np.int64)
+        else:
+            srcs = self._pend_vals
+            counts = {key: self._appended.get(key, 0)
+                      - self._launched.get(key, 0) for key in rowmap}
+            R = max(counts.values(), default=0)
+            offs = np.zeros(ex.KP, dtype=np.int64)
+            for key, r in rowmap.items():
+                offs[r] = self._launched.get(key, 0) - self._base.get(key, 0)
+        # --- build the rectangle in the narrowest wire dtype ---
+        arrays = [a for key in rowmap for a in srcs.get(key, []) if len(a)]
+        if arrays:
+            lo = min(a.min() for a in arrays)
+            hi = max(a.max() for a in arrays)
+            probe = np.array([lo, hi])
+        else:
+            probe = np.zeros(0)
+        wire = ex.narrow(probe)
+        blk = np.zeros((K, max(R, 1)), dtype=wire)
+        for key, r in rowmap.items():
+            c = 0
+            for a in srcs.get(key, []):
+                blk[r, c:c + len(a)] = a
+                c += len(a)
+        # --- window descriptors in ring coordinates ---
+        if self._wdesc:
+            wrows = np.concatenate([
+                np.full(len(lens), rowmap[key], dtype=np.int64)
+                for key, _, lens in self._wdesc])
+            wstarts = np.concatenate([
+                abs_lo - self._base.get(key, 0)
+                for key, abs_lo, _ in self._wdesc])
+            wlens = np.concatenate([lens for _, _, lens in self._wdesc])
+        else:
+            wrows = wstarts = wlens = np.zeros(0, dtype=np.int64)
+        ex.launch(self._hdr, blk, offs[:K], wrows, wstarts, wlens)
+        # --- advance cursors, apply deferred purges ---
+        for key in rowmap:
+            self._launched[key] = self._appended.get(key, 0)
+        for key, pos in self._purge_pos.items():
+            st = self._keys.get(key)
+            if st is not None:
+                st.archive.purge_below(pos)
+        self._pend_vals = {}
+        self._pend_rows = 0
+        self._wdesc, self._hdr, self._n_wins = [], [], 0
+        self._purge_pos = {}
+
+    # ---------------------------------------------------------------- harvest
+
+    def _build_results(self, harvested):
+        outs = []
+        res_dt = self.reducer.dtype
+        fill_empties = self.reducer.op in ("min", "max", "prod")
+        host_ident = self.reducer._identity()
+        for hdr, out in harvested:
+            if out.dtype != res_dt:
+                out = out.astype(res_dt)
+            off = 0
+            for key, ids, ts, lens in hdr:
+                n = len(ids)
+                vals = out[off:off + n]
+                if fill_empties and len(lens) and (lens == 0).any():
+                    vals = vals.copy()
+                    vals[lens == 0] = host_ident
+                outs.append(self._make_results(key, ids, ts,
+                                               {self.out_field: vals}))
+                off += n
+        return outs
+
+    def process(self, batch):
+        super().process(batch)  # fired windows are enqueued, not returned
+        outs = self._build_results(self.executor.poll())
+        if not outs:
+            return np.zeros(0, dtype=self._result_dtype)
+        return np.concatenate(outs)
+
+    def flush(self):
+        super().flush()          # enqueue EOS leftovers
+        self._flush_batch()      # launch the partial batch
+        outs = self._build_results(self.executor.drain())
+        if not outs:
+            return np.zeros(0, dtype=self._result_dtype)
+        return np.concatenate(outs)
+
+    def use_incremental(self):
+        raise TypeError("the device path is non-incremental only "
+                        "(win_seq_gpu.hpp supports NIC device functors)")
+
+
+#: reducer ops the resident path evaluates on device (count needs no device
+#: work and keeps the legacy path; arbitrary JAX fns need staged (B, pad)
+#: column views, which the segment-restaging executor provides)
+_RESIDENT_OPS = ("sum", "min", "max", "prod")
+
+
+def make_device_core(worker, fn, dev_kw):
     """Build the device-batched core for a prototype host worker (a WinSeq
     carrying the farm's per-worker spec/config/role plumbing)."""
-    return DeviceWinSeqCore(worker.spec, fn, config=worker.config,
-                            role=worker.role, map_indexes=worker.map_indexes,
-                            result_ts_slide=worker.result_ts_slide, **dev_kw)
+    return make_core_for(worker.spec, fn, config=worker.config,
+                         role=worker.role, map_indexes=worker.map_indexes,
+                         result_ts_slide=worker.result_ts_slide, **dev_kw)
+
+
+def make_core_for(spec, winfunc, *, batch_len=512, config=None,
+                  role=Role.SEQ, map_indexes=(0, 1), result_ts_slide=None,
+                  device=None, depth=None, use_pallas=False,
+                  compute_dtype=None, use_resident=None,
+                  flush_rows=1 << 20):
+    """Choose the device core implementation: resident-archive (preferred —
+    each row crosses the wire once) when the function is a built-in monoid
+    the resident executor evaluates; segment-restaging otherwise."""
+    resident = use_resident
+    if resident is None:
+        resident = (not use_pallas and isinstance(winfunc, Reducer)
+                    and winfunc.op in _RESIDENT_OPS
+                    # a float cumsum accumulates rounding error the host
+                    # path's per-window reduction does not; floats keep the
+                    # segment-restaging path unless the user opts in
+                    and not (winfunc.op == "sum"
+                             and np.issubdtype(winfunc.dtype, np.floating)))
+    if resident:
+        return ResidentWinSeqCore(
+            spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
+            config=config, role=role, map_indexes=map_indexes,
+            result_ts_slide=result_ts_slide, device=device,
+            depth=depth if depth is not None else 8,
+            compute_dtype=compute_dtype)
+    return DeviceWinSeqCore(
+        spec, winfunc, batch_len=batch_len, config=config, role=role,
+        map_indexes=map_indexes, result_ts_slide=result_ts_slide,
+        device=device, depth=depth if depth is not None else 4,
+        use_pallas=use_pallas, compute_dtype=compute_dtype)
 
 
 class _DeviceCoreFactory:
@@ -211,18 +470,20 @@ class WinSeqTPU(_Pattern):
                  batch_len=512, name="win_seq_tpu",
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
-                 depth=4, use_pallas=False, compute_dtype=None):
+                 depth=None, use_pallas=False, compute_dtype=None,
+                 use_resident=None, flush_rows=1 << 20):
         super().__init__(name, parallelism=1)
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self._kw = dict(batch_len=batch_len, config=config, role=role,
                         map_indexes=map_indexes,
                         result_ts_slide=result_ts_slide, device=device,
                         depth=depth, use_pallas=use_pallas,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype,
+                        use_resident=use_resident, flush_rows=flush_rows)
         self.winfunc = winfunc
 
     def make_core(self):
-        return DeviceWinSeqCore(self.spec, self.winfunc, **self._kw)
+        return make_core_for(self.spec, self.winfunc, **self._kw)
 
     @property
     def result_schema(self):
@@ -245,11 +506,13 @@ class WinFarmTPU(_DeviceCoreFactory, WinFarm):
     def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
                  pardegree=2, batch_len=512, name="win_farm_tpu",
                  ordered=True, n_emitters=1, config=None, role=Role.SEQ,
-                 device=None, depth=4, use_pallas=False, compute_dtype=None):
+                 device=None, depth=None, use_pallas=False,
+                 compute_dtype=None, use_resident=None, flush_rows=1 << 20):
         self._raw_fn = winfunc
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            use_resident=use_resident, flush_rows=flush_rows)
         super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
                          pardegree=pardegree, name=name, ordered=ordered,
                          n_emitters=n_emitters, config=config, role=role)
@@ -263,11 +526,13 @@ class KeyFarmTPU(_DeviceCoreFactory, KeyFarm):
     def __init__(self, winfunc, win_len, slide_len, win_type=WinType.CB,
                  pardegree=2, batch_len=512, name="key_farm_tpu",
                  routing=None, config=None, role=Role.SEQ, device=None,
-                 depth=4, use_pallas=False, compute_dtype=None):
+                 depth=None, use_pallas=False, compute_dtype=None,
+                 use_resident=None, flush_rows=1 << 20):
         self._raw_fn = winfunc
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            use_resident=use_resident, flush_rows=flush_rows)
         super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
                          pardegree=pardegree, name=name, routing=routing,
                          config=config, role=role)
@@ -282,12 +547,14 @@ class PaneFarmTPU(PaneFarm):
     def __init__(self, plq_func, wlq_func, win_len, slide_len,
                  win_type=WinType.CB, plq_degree=1, wlq_degree=1,
                  name="pane_farm_tpu", plq_on_device=True, wlq_on_device=True,
-                 batch_len=512, device=None, depth=4, use_pallas=False,
-                 compute_dtype=None, **kw):
+                 batch_len=512, device=None, depth=None, use_pallas=False,
+                 compute_dtype=None, use_resident=None, flush_rows=1 << 20,
+                 **kw):
         self._on_device = {"plq": plq_on_device, "wlq": wlq_on_device}
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            use_resident=use_resident, flush_rows=flush_rows)
         super().__init__(plq_func, wlq_func, win_len, slide_len, win_type,
                          plq_degree=plq_degree, wlq_degree=wlq_degree,
                          name=name, **kw)
@@ -325,12 +592,14 @@ class WinMapReduceTPU(WinMapReduce):
     def __init__(self, map_func, reduce_func, win_len, slide_len,
                  win_type=WinType.CB, map_degree=2, reduce_degree=1,
                  name="win_mr_tpu", map_on_device=True,
-                 reduce_on_device=False, batch_len=512, device=None, depth=4,
-                 use_pallas=False, compute_dtype=None, **kw):
+                 reduce_on_device=False, batch_len=512, device=None,
+                 depth=None, use_pallas=False, compute_dtype=None,
+                 use_resident=None, flush_rows=1 << 20, **kw):
         self._on_device = {"map": map_on_device, "reduce": reduce_on_device}
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            use_resident=use_resident, flush_rows=flush_rows)
         super().__init__(map_func, reduce_func, win_len, slide_len, win_type,
                          map_degree=map_degree, reduce_degree=reduce_degree,
                          name=name, **kw)

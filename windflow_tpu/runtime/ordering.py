@@ -71,6 +71,7 @@ class OrderingCore:
         self.pos_field = "id" if mode is OrderingMode.ID else "ts"
         self._keys: dict[int, _KeyBuf] = {}
         self.watermark = np.full(n_channels, _NEG_INF, dtype=np.int64)
+        self._released_upto = _NEG_INF
 
     def _buf(self, key):
         b = self._keys.get(key)
@@ -123,15 +124,29 @@ class OrderingCore:
         order = np.argsort(keys, kind="stable")
         sk = keys[order]
         bounds = np.flatnonzero(np.diff(sk)) + 1
+        touched = []
         for grp in np.split(order, bounds):
             key = int(keys[grp[0]])
             kb = self._buf(key)
             rows = batch[grp]
             kb.chans[channel].append(rows)
+            touched.append((key, kb))
         wm = self.watermark
         wm[channel] = max(int(wm[channel]),
                           int(batch[self.pos_field].max()))
-        out.extend(self._release_all(int(wm.min())))
+        upto = int(wm.min())
+        if upto > self._released_upto:
+            # watermark advanced: rows of ANY key may become releasable
+            self._released_upto = upto
+            out.extend(self._release_all(upto))
+        else:
+            # no advance: only this batch's keys can have new releasable
+            # rows (those below the standing watermark) — skip the
+            # every-key scan on the merge hot path
+            for key, kb in touched:
+                rel = self._release(kb, key, upto)
+                if rel is not None:
+                    out.append(rel)
         return out
 
     def _release_all(self, upto: int):
@@ -149,7 +164,9 @@ class OrderingCore:
         """Exclude a finished channel from the watermark min and release
         what that unblocks (orderingNode.hpp:182-221)."""
         self.watermark[channel] = 2 ** 62
-        return self._release_all(int(self.watermark.min()))
+        upto = int(self.watermark.min())
+        self._released_upto = max(self._released_upto, upto)
+        return self._release_all(upto)
 
     def flush(self):
         """EOS: release everything, then the per-key marker (renumbered too,
