@@ -1,0 +1,417 @@
+// windflow-tpu native host runtime: the window-core hot loop in C++.
+//
+// The reference library's entire hot path is C++ (win_seq.hpp:268-474 runs
+// per tuple on a pinned thread).  This translation unit is its counterpart
+// for the TPU framework: the per-row window bookkeeping — out-of-order
+// drops, per-key archives, window creation/firing arithmetic, PLQ/MAP
+// result renumbering, result-timestamp rules, EOS marker handling
+// (win_seq.hpp:268-474, window.hpp:63-87, basic.hpp:136) — plus the
+// device-staging assembly of the resident-archive path (ops/resident.py):
+// narrow-dtype append rectangles, per-key ring offsets, fired-window
+// descriptors in ring coordinates, and ring rebase decisions.
+//
+// Semantics are kept bit-identical to the Python cores (core/winseq.py,
+// patterns/win_seq_tpu.py:ResidentWinSeqCore); tests/test_native.py asserts
+// the differential.  Python calls in through a plain C ABI via ctypes, so
+// every call releases the GIL — farm workers get true multicore host
+// parallelism, like the reference's FastFlow pinned threads.
+//
+// Build: `make -C native` -> libwfnative.so (loaded by windflow_tpu/native).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+using i64 = long long;
+using u8 = unsigned char;
+
+static const i64 NEG_INF = -(1LL << 62);
+
+static inline i64 bucket(i64 n, i64 lo = 8) {
+    i64 b = lo;
+    while (b < n) b *= 2;
+    return b;
+}
+
+static inline i64 pymod(i64 a, i64 m) {  // Python's nonnegative modulo
+    i64 r = a % m;
+    return r < 0 ? r + m : r;
+}
+
+namespace {
+
+enum Role { SEQ = 0, PLQ = 1, WLQ = 2, MAP = 3, REDUCE = 4 };
+enum WinKind { CB = 0, TB = 1 };
+
+struct KeyState {
+    // live archive: SoA ordered by pos, purge advances `start`
+    // (core/archive.py's KeyArchive, reference stream_archive.hpp)
+    std::vector<i64> pos, ts, val;
+    size_t start = 0;
+    i64 appended = 0;      // rows ever archived (absolute row domain)
+    i64 launched = 0;      // rows already shipped to the device ring
+    i64 ring_base = 0;     // absolute row index of ring column 0
+    i64 last_pos = NEG_INF;
+    i64 initial_id = 0, first_gwid = 0;
+    i64 next_lwid = 0, n_fired = 0, emit_counter = 0;
+    i64 marker_pos = NEG_INF, marker_ts = 0;
+    i64 purge_pos = NEG_INF;  // purge deferred to flush (rebase invariant)
+    int row = -1;             // dense ring row
+
+    size_t live() const { return pos.size() - start; }
+
+    void purge() {
+        if (purge_pos <= NEG_INF) return;
+        const i64 *p = pos.data() + start;
+        size_t cut = std::lower_bound(p, p + live(), purge_pos) - p;
+        start += cut;
+        purge_pos = NEG_INF;
+        // amortised compaction (archive.py:purge_below)
+        if (start > 4096 && start > live()) {
+            pos.erase(pos.begin(), pos.begin() + start);
+            ts.erase(ts.begin(), ts.begin() + start);
+            val.erase(val.begin(), val.begin() + start);
+            start = 0;
+        }
+    }
+};
+
+struct Launch {
+    i64 K = 0, R = 0, B = 0, KP = 0, cap = 0;
+    int wire = 0;   // 0=int8 1=int16 2=int32
+    int rebase = 0;
+    std::vector<u8> blk;              // K*R in wire dtype
+    std::vector<i64> offs;            // K ring write offsets
+    std::vector<int32_t> wrows, wstarts, wlens;   // B window descriptors
+    std::vector<i64> hkey, hid, hts, hlen;        // B result headers
+};
+
+struct Core {
+    i64 win, slide;
+    int kind, role;
+    i64 id_outer, n_outer, slide_outer, id_inner, n_inner, slide_inner;
+    i64 map_idx0, map_idx1, result_ts_slide;
+    i64 batch_len, flush_rows;
+    int max_wire;   // widest wire dtype: 2=int32 (default), 3=int64
+    bool hopping;
+
+    std::unordered_map<i64, int> rowmap;
+    std::vector<int> direct;          // fast dense map for small keys
+    std::vector<KeyState> keys;       // dense by ring row
+    std::vector<i64> rowkey;
+
+    // pending fired windows (absolute row coords; ring coords at flush)
+    std::vector<int32_t> wrow;
+    std::vector<i64> wlo, wlen, hkey, hid, hts;
+    i64 pend_rows = 0;
+
+    i64 KP = 0, cap = 0;              // current ring geometry
+    std::deque<Launch> queue;
+
+    Core(i64 win_, i64 slide_, int kind_, int role_,
+         i64 io, i64 no, i64 so, i64 ii, i64 ni, i64 si,
+         i64 m0, i64 m1, i64 rts, i64 bl, i64 fr, int mw)
+        : win(win_), slide(slide_), kind(kind_), role(role_),
+          id_outer(io), n_outer(no), slide_outer(so),
+          id_inner(ii), n_inner(ni), slide_inner(si),
+          map_idx0(m0), map_idx1(m1), result_ts_slide(rts),
+          batch_len(bl), flush_rows(fr), max_wire(mw),
+          hopping(slide_ > win_), direct(4096, -1) {}
+
+    KeyState &state(i64 key) {
+        int r;
+        if (key >= 0 && key < (i64)direct.size()) {
+            r = direct[(size_t)key];
+            if (r >= 0) return keys[r];
+        } else {
+            auto it = rowmap.find(key);
+            if (it != rowmap.end()) return keys[it->second];
+        }
+        r = (int)keys.size();
+        if (key >= 0 && key < (i64)direct.size()) direct[(size_t)key] = r;
+        else rowmap.emplace(key, r);
+        rowkey.push_back(key);
+        keys.emplace_back();
+        KeyState &st = keys.back();
+        st.row = r;
+        // farm distribution math (windows.py PatternConfig,
+        // reference win_seq.hpp:307-314)
+        i64 a = pymod(id_inner - pymod(key, n_inner), n_inner);
+        i64 b = pymod(id_outer - pymod(key, n_outer), n_outer);
+        st.first_gwid = a * n_outer + b;
+        i64 init_outer = b * slide_outer, init_inner = a * slide_inner;
+        st.initial_id = (role == WLQ || role == REDUCE)
+                            ? init_inner : init_outer + init_inner;
+        st.emit_counter = (role == MAP) ? map_idx0 : 0;
+        return st;
+    }
+
+    void emit_windows(KeyState &st, i64 key, i64 w_from, i64 w_to, bool eos) {
+        const i64 stride = n_outer * n_inner;
+        const i64 *p = st.pos.data() + st.start;
+        const size_t n = st.live();
+        for (i64 w = w_from; w < w_to; ++w) {
+            i64 gwid = st.first_gwid + w * stride;
+            i64 s_abs = w * slide + st.initial_id;
+            i64 e_abs = s_abs + win;
+            size_t lo = std::lower_bound(p, p + n, s_abs) - p;
+            size_t hi = eos ? n : (std::lower_bound(p, p + n, e_abs) - p);
+            // result ts (winseq.py:_result_ts; window.hpp:121-124,154)
+            i64 out_ts = 0;
+            if (kind == TB) {
+                out_ts = gwid * result_ts_slide + win - 1;
+            } else {
+                size_t idx = std::lower_bound(p, p + n, e_abs) - p;
+                if (idx > 0 && p[idx - 1] >= s_abs)
+                    out_ts = st.ts[st.start + idx - 1];
+            }
+            if (st.marker_pos > NEG_INF && st.marker_pos < e_abs)
+                out_ts = st.marker_ts;
+            // result id incl. PLQ/MAP renumbering (win_seq.hpp:396-405)
+            i64 rid;
+            if (role == MAP) {
+                rid = st.emit_counter;
+                st.emit_counter += map_idx1;
+            } else if (role == PLQ) {
+                i64 ioff = pymod(id_inner - pymod(key, n_inner), n_inner);
+                rid = ioff + st.emit_counter * n_inner;
+                st.emit_counter += 1;
+            } else {
+                rid = gwid;
+            }
+            i64 abs_lo = (st.appended - (i64)n) + (i64)lo;
+            wrow.push_back(st.row);
+            wlo.push_back(abs_lo);
+            wlen.push_back((i64)(hi - lo));
+            hkey.push_back(key);
+            hid.push_back(rid);
+            hts.push_back(out_ts);
+            if (!eos) st.purge_pos = std::max(st.purge_pos, s_abs);
+        }
+    }
+
+    void flush() {
+        if (hkey.empty() && pend_rows == 0) return;
+        const i64 K = (i64)keys.size();
+        const i64 KPb = bucket(std::max<i64>(K, 1));
+        bool rebase = (cap == 0) || (KP < KPb);
+        i64 maxpend = 0;
+        for (auto &st : keys)
+            maxpend = std::max(maxpend, st.appended - st.launched);
+        if (!rebase) {
+            const i64 Rb = bucket(std::max<i64>(maxpend, 1));
+            for (auto &st : keys) {
+                if (st.launched - st.ring_base + Rb > cap) {
+                    rebase = true;
+                    break;
+                }
+            }
+        }
+        i64 R;
+        if (rebase) {
+            i64 maxlive = 0;
+            for (auto &st : keys)
+                maxlive = std::max(maxlive, (i64)st.live());
+            i64 slack =
+                std::max<i64>(flush_rows / std::max<i64>(K, 1), 64);
+            KP = KPb;
+            cap = bucket(std::max<i64>(2 * maxlive + 2 * slack, 16));
+            R = maxlive;
+            for (auto &st : keys) {
+                st.ring_base = st.appended - (i64)st.live();
+                st.launched = st.ring_base;
+            }
+        } else {
+            R = maxpend;
+        }
+        // narrowest wire dtype over the rows to ship
+        bool anyv = false;
+        i64 vmin = 0, vmax = 0;
+        for (auto &st : keys) {
+            i64 live_start = st.appended - (i64)st.live();
+            for (size_t j = st.start + (size_t)(st.launched - live_start);
+                 j < st.pos.size(); ++j) {
+                i64 v = st.val[j];
+                if (!anyv) { vmin = vmax = v; anyv = true; }
+                else {
+                    vmin = std::min(vmin, v);
+                    vmax = std::max(vmax, v);
+                }
+            }
+        }
+        Launch L;
+        if (!anyv || (vmin >= -128 && vmax <= 127)) L.wire = 0;
+        else if (vmin >= -32768 && vmax <= 32767) L.wire = 1;
+        else if (max_wire <= 2 || (vmin >= INT32_MIN && vmax <= INT32_MAX))
+            L.wire = 2;
+        else L.wire = 3;   // int64 wire (64-bit accumulate dtype)
+        const i64 isz = 1LL << L.wire;
+        const i64 Rr = std::max<i64>(R, 1);
+        L.blk.assign((size_t)(K * Rr * isz), 0);
+        L.offs.assign((size_t)K, 0);
+        for (auto &st : keys) {
+            i64 live_start = st.appended - (i64)st.live();
+            size_t j0 = st.start + (size_t)(st.launched - live_start);
+            i64 cnt = (i64)(st.pos.size() - j0);
+            L.offs[(size_t)st.row] = st.launched - st.ring_base;
+            u8 *dst = L.blk.data() + (size_t)(st.row * Rr * isz);
+            const i64 *src = st.val.data() + j0;
+            if (L.wire == 0)
+                for (i64 c = 0; c < cnt; ++c)
+                    ((int8_t *)dst)[c] = (int8_t)src[c];
+            else if (L.wire == 1)
+                for (i64 c = 0; c < cnt; ++c)
+                    ((int16_t *)dst)[c] = (int16_t)src[c];
+            else if (L.wire == 2)
+                for (i64 c = 0; c < cnt; ++c)
+                    ((int32_t *)dst)[c] = (int32_t)src[c];
+            else
+                std::memcpy(dst, src, (size_t)cnt * 8);
+            st.launched = st.appended;
+        }
+        const i64 B = (i64)hkey.size();
+        L.wrows.resize((size_t)B);
+        L.wstarts.resize((size_t)B);
+        L.wlens.resize((size_t)B);
+        L.hlen.resize((size_t)B);
+        for (i64 i = 0; i < B; ++i) {
+            int rr = wrow[(size_t)i];
+            L.wrows[(size_t)i] = rr;
+            L.wstarts[(size_t)i] =
+                (int32_t)(wlo[(size_t)i] - keys[(size_t)rr].ring_base);
+            L.wlens[(size_t)i] = (int32_t)wlen[(size_t)i];
+            L.hlen[(size_t)i] = wlen[(size_t)i];
+        }
+        L.hkey = std::move(hkey);
+        L.hid = std::move(hid);
+        L.hts = std::move(hts);
+        L.K = K; L.R = Rr; L.B = B; L.KP = KP; L.cap = cap;
+        L.rebase = rebase ? 1 : 0;
+        queue.push_back(std::move(L));
+        for (auto &st : keys) st.purge();
+        pend_rows = 0;
+        wrow.clear(); wlo.clear(); wlen.clear();
+        hkey = {}; hid = {}; hts = {};
+    }
+
+    i64 process(const u8 *base, i64 n, i64 itemsize, i64 o_key, i64 o_id,
+                i64 o_ts, i64 o_marker, i64 o_val) {
+        const size_t q0 = queue.size();
+        for (i64 i = 0; i < n; ++i) {
+            const u8 *rp = base + i * itemsize;
+            i64 key, id, tsv, val;
+            std::memcpy(&key, rp + o_key, 8);
+            std::memcpy(&id, rp + o_id, 8);
+            std::memcpy(&tsv, rp + o_ts, 8);
+            std::memcpy(&val, rp + o_val, 8);
+            const bool mk = rp[o_marker] != 0;
+            KeyState &st = state(key);
+            const i64 pos = (kind == CB) ? id : tsv;
+            if (pos < st.last_pos) continue;       // out-of-order drop
+            st.last_pos = pos;
+            if (pos < st.initial_id) continue;     // before worker's slice
+            const i64 rel = pos - st.initial_id;
+            if (hopping && !mk && (rel % slide) >= win) continue;  // gap
+            if (mk) {
+                st.marker_pos = pos;
+                st.marker_ts = tsv;
+            } else {
+                st.pos.push_back(pos);
+                st.ts.push_back(tsv);
+                st.val.push_back(val);
+                st.appended++;
+                pend_rows++;
+            }
+            const i64 last_w =
+                hopping ? rel / slide : (rel + slide) / slide - 1;
+            if (last_w + 1 > st.next_lwid) st.next_lwid = last_w + 1;
+            const i64 n_fireable =
+                (rel >= win) ? (rel - win) / slide + 1 : 0;
+            const i64 to =
+                std::min(std::max(n_fireable, st.n_fired), st.next_lwid);
+            if (to > st.n_fired) {
+                const i64 from = st.n_fired;
+                st.n_fired = to;
+                emit_windows(st, key, from, to, false);
+            }
+            if ((i64)hkey.size() >= batch_len || pend_rows >= flush_rows)
+                flush();
+        }
+        return (i64)(queue.size() - q0);
+    }
+
+    i64 eos() {
+        const size_t q0 = queue.size();
+        for (size_t r = 0; r < keys.size(); ++r) {
+            KeyState &st = keys[r];
+            if (st.n_fired < st.next_lwid) {
+                const i64 from = st.n_fired;
+                st.n_fired = st.next_lwid;
+                emit_windows(st, rowkey[r], from, st.next_lwid, true);
+            }
+        }
+        flush();
+        return (i64)(queue.size() - q0);
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *wf_core_new(i64 win, i64 slide, int win_type, int role,
+                  i64 id_outer, i64 n_outer, i64 slide_outer,
+                  i64 id_inner, i64 n_inner, i64 slide_inner,
+                  i64 map_idx0, i64 map_idx1, i64 result_ts_slide,
+                  i64 batch_len, i64 flush_rows, int max_wire) {
+    return new Core(win, slide, win_type, role, id_outer, n_outer,
+                    slide_outer, id_inner, n_inner, slide_inner, map_idx0,
+                    map_idx1, result_ts_slide, batch_len, flush_rows,
+                    max_wire);
+}
+
+void wf_core_free(void *h) { delete (Core *)h; }
+
+i64 wf_core_process(void *h, const void *base, i64 n, i64 itemsize,
+                    i64 o_key, i64 o_id, i64 o_ts, i64 o_marker,
+                    i64 o_val) {
+    return ((Core *)h)->process((const u8 *)base, n, itemsize, o_key, o_id,
+                                o_ts, o_marker, o_val);
+}
+
+i64 wf_core_eos(void *h) { return ((Core *)h)->eos(); }
+
+int wf_launch_peek(void *h, i64 *K, i64 *R, i64 *B, int *wire, int *rebase,
+                   i64 *KP, i64 *cap) {
+    Core *c = (Core *)h;
+    if (c->queue.empty()) return 0;
+    Launch &L = c->queue.front();
+    *K = L.K; *R = L.R; *B = L.B; *wire = L.wire; *rebase = L.rebase;
+    *KP = L.KP; *cap = L.cap;
+    return 1;
+}
+
+void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
+                    int32_t *wstarts, int32_t *wlens, i64 *hkey, i64 *hid,
+                    i64 *hts, i64 *hlen) {
+    Core *c = (Core *)h;
+    Launch &L = c->queue.front();
+    const i64 isz = 1LL << L.wire;
+    std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));
+    std::memcpy(offs, L.offs.data(), (size_t)L.K * 8);
+    if (L.B) {
+        std::memcpy(wrows, L.wrows.data(), (size_t)L.B * 4);
+        std::memcpy(wstarts, L.wstarts.data(), (size_t)L.B * 4);
+        std::memcpy(wlens, L.wlens.data(), (size_t)L.B * 4);
+        std::memcpy(hkey, L.hkey.data(), (size_t)L.B * 8);
+        std::memcpy(hid, L.hid.data(), (size_t)L.B * 8);
+        std::memcpy(hts, L.hts.data(), (size_t)L.B * 8);
+        std::memcpy(hlen, L.hlen.data(), (size_t)L.B * 8);
+    }
+    c->queue.pop_front();
+}
+
+}  // extern "C"

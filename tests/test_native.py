@@ -1,0 +1,178 @@
+"""Differential tests for the C++ native window core (native/wf_native.cpp
+via NativeResidentCore): byte-identical results to the pure-Python host core
+on the same streams — the native twin of test_resident.py.  Skipped when the
+native toolchain is unavailable."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.core.windows import PatternConfig, Role, WindowSpec, WinType
+from windflow_tpu.core.winseq import WinSeqCore
+from windflow_tpu.ops.functions import Reducer
+
+native = pytest.importorskip("windflow_tpu.native")
+if not native.available():
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+from windflow_tpu.patterns.native_core import NativeResidentCore  # noqa: E402
+from windflow_tpu.patterns.win_seq_tpu import make_core_for  # noqa: E402
+
+SCHEMA = Schema(value=np.int64)
+
+
+def make_native(spec, reducer, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return NativeResidentCore(spec, reducer, **kw)
+
+
+def run_core(core, batches):
+    outs = []
+    for b in batches:
+        r = core.process(b)
+        if len(r):
+            outs.append(r)
+    r = core.flush()
+    if len(r):
+        outs.append(r)
+    if not outs:
+        return np.zeros(0, dtype=core._result_dtype)
+    return np.sort(np.concatenate(outs), order=["key", "id"])
+
+
+def cb_stream(n_keys, per_key, chunk=37, seed=0, lo_val=-50, hi_val=100):
+    rng = np.random.default_rng(seed)
+    batches = []
+    for lo in range(0, per_key, chunk):
+        m = min(chunk, per_key - lo)
+        ids = np.repeat(np.arange(lo, lo + m), n_keys)
+        keys = np.tile(np.arange(n_keys), m)
+        vals = rng.integers(lo_val, hi_val, size=m * n_keys).astype(np.int64)
+        batches.append(batch_from_columns(
+            SCHEMA, key=keys, id=ids, ts=ids, value=vals))
+    return batches
+
+
+def assert_equal_results(a, b):
+    assert len(a) == len(b)
+    for f in ("key", "id", "ts", "value"):
+        np.testing.assert_array_equal(a[f], b[f])
+
+
+def test_native_is_default_selection():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(16, 4, WinType.CB), Reducer("sum"))
+    assert isinstance(core, NativeResidentCore)
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max", "prod"])
+@pytest.mark.parametrize("win,slide", [(16, 4), (8, 8), (4, 12)])
+@pytest.mark.parametrize("n_keys", [1, 5])
+def test_native_cb_matches_host(op, win, slide, n_keys):
+    lo, hi = (1, 3) if op == "prod" else (-50, 100)
+    batches = cb_stream(n_keys, 503, seed=win * 31 + slide,
+                        lo_val=lo, hi_val=hi)
+    spec = WindowSpec(win, slide, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer(op)), batches)
+    nat = make_native(spec, Reducer(op), batch_len=64, flush_rows=200)
+    assert_equal_results(host, run_core(nat, batches))
+
+
+@pytest.mark.parametrize("op", ["sum", "max"])
+@pytest.mark.parametrize("win,slide", [(20, 5), (10, 10), (6, 16)])
+def test_native_tb_matches_host(op, win, slide):
+    rng = np.random.default_rng(win + slide)
+    nk, per = 3, 400
+    ts_all = np.sort(rng.integers(0, 900, size=per))
+    batches = []
+    for lo in range(0, per, 53):
+        m = min(53, per - lo)
+        batches.append(batch_from_columns(
+            SCHEMA, key=np.tile(np.arange(nk), m),
+            id=np.repeat(np.arange(lo, lo + m), nk),
+            ts=np.repeat(ts_all[lo:lo + m], nk),
+            value=rng.integers(0, 100, size=m * nk).astype(np.int64)))
+    spec = WindowSpec(win, slide, WinType.TB)
+    host = run_core(WinSeqCore(spec, Reducer(op)), batches)
+    nat = make_native(spec, Reducer(op), batch_len=32, flush_rows=150)
+    assert_equal_results(host, run_core(nat, batches))
+
+
+@pytest.mark.parametrize("role,cfg", [
+    (Role.PLQ, PatternConfig(0, 1, 8, 1, 2, 8)),
+    (Role.MAP, PatternConfig(0, 1, 8, 0, 1, 8)),
+    (Role.WLQ, PatternConfig(1, 2, 8, 0, 1, 8)),
+])
+def test_native_role_renumbering(role, cfg):
+    batches = cb_stream(3, 300, chunk=29, seed=7)
+    spec = WindowSpec(8, 8, WinType.CB)
+    host = run_core(
+        WinSeqCore(spec, Reducer("sum"), config=cfg, role=role,
+                   map_indexes=(1, 3)), batches)
+    nat = make_native(spec, Reducer("sum"), config=cfg, role=role,
+                      map_indexes=(1, 3), batch_len=32, flush_rows=100)
+    assert_equal_results(host, run_core(nat, batches))
+
+
+def test_native_out_of_order_drops():
+    """Late rows are dropped identically (win_seq.hpp:293-305)."""
+    rng = np.random.default_rng(13)
+    ids = np.arange(200)
+    ids[50] = 10       # a late row mid-stream
+    ids[120] = 100
+    vals = rng.integers(0, 50, size=200).astype(np.int64)
+    b = batch_from_columns(SCHEMA, key=np.zeros(200), id=ids, ts=ids,
+                           value=vals)
+    spec = WindowSpec(12, 4, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), [b])
+    nat = make_native(spec, Reducer("sum"), batch_len=16, flush_rows=64)
+    assert_equal_results(host, run_core(nat, [b]))
+
+
+def test_native_markers_and_empty_flush():
+    """EOS markers advance firing without being archived."""
+    from windflow_tpu.core.tuples import MARKER_FIELD
+    b = batch_from_columns(SCHEMA, key=np.zeros(20), id=np.arange(20),
+                           ts=np.arange(20) * 10,
+                           value=np.ones(20, dtype=np.int64))
+    m = batch_from_columns(SCHEMA, key=np.zeros(1), id=[40], ts=[400],
+                           value=[0])
+    m[MARKER_FIELD] = True
+    spec = WindowSpec(8, 4, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), [b, m])
+    nat = make_native(spec, Reducer("sum"), batch_len=8, flush_rows=32)
+    assert_equal_results(host, run_core(nat, [b, m]))
+
+
+def test_native_falls_back_on_float_payload():
+    schema = Schema(value=np.float64)
+    b = batch_from_columns(schema, key=np.zeros(10), id=np.arange(10),
+                           ts=np.arange(10),
+                           value=np.arange(10, dtype=np.float64))
+    nat = make_native(WindowSpec(4, 2, WinType.CB), Reducer("max"),
+                      batch_len=8, flush_rows=32)
+    out = np.concatenate([nat.process(b), nat.flush()])
+    host_core = WinSeqCore(WindowSpec(4, 2, WinType.CB), Reducer("max"))
+    want = np.concatenate([host_core.process(b), host_core.flush()])
+    np.testing.assert_array_equal(np.sort(out, order=["key", "id"])["value"],
+                                  np.sort(want, order=["key", "id"])["value"])
+
+
+def test_native_wide_values_use_int32_wire():
+    batches = cb_stream(2, 256, seed=5, lo_val=-40000, hi_val=40000)
+    spec = WindowSpec(16, 4, WinType.CB)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    nat = make_native(spec, Reducer("sum"), batch_len=64, flush_rows=300)
+    assert_equal_results(host, run_core(nat, batches))
+
+
+def test_native_hopping_gaps():
+    batches = cb_stream(2, 300, chunk=41, seed=21)
+    spec = WindowSpec(4, 10, WinType.CB)   # hopping: slide > win
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    nat = make_native(spec, Reducer("sum"), batch_len=16, flush_rows=100)
+    assert_equal_results(host, run_core(nat, batches))

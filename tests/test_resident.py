@@ -9,6 +9,15 @@ import warnings
 import numpy as np
 import pytest
 
+# pin this module to the pure-Python resident core; the native C++ core has
+# its own differential suite (test_native.py)
+pytestmark = pytest.mark.usefixtures("no_native")
+
+
+@pytest.fixture(autouse=True)
+def no_native(monkeypatch):
+    monkeypatch.setenv("WF_NO_NATIVE", "1")
+
 from windflow_tpu.core.tuples import Schema, batch_from_columns
 from windflow_tpu.core.windows import PatternConfig, Role, WindowSpec, WinType
 from windflow_tpu.core.winseq import WinSeqCore
@@ -160,6 +169,19 @@ def test_resident_float_sum_keeps_restaging_path():
         core = make_core_for(WindowSpec(4, 2, WinType.CB),
                              Reducer("sum", dtype=np.float32))
     assert not isinstance(core, ResidentWinSeqCore)
+
+
+def test_resident_64bit_compute_dtype_needs_x64():
+    """compute_dtype=int64 without jax x64 would silently truncate device
+    buffers to 32 bits; the core must refuse instead."""
+    import jax
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled in this environment")
+    with pytest.raises(ValueError, match="x64"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ResidentWinSeqCore(WindowSpec(4, 2, WinType.CB), Reducer("sum"),
+                               compute_dtype=np.int64)
 
 
 def test_resident_count_uses_legacy_path():

@@ -1,0 +1,76 @@
+"""ctypes bindings for the C++ native host runtime (native/wf_native.cpp).
+
+The shared library is built on demand with ``make -C native`` (g++ only, no
+third-party dependencies) and cached; if the toolchain is unavailable the
+framework falls back to the pure-Python cores transparently.  Every call
+into the library releases the GIL, so farm workers running native cores get
+true multicore host parallelism — the FastFlow-pinned-threads property the
+reference gets for free from being a C++ library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO = os.path.join(_DIR, "libwfnative.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+i64 = ctypes.c_longlong
+p_i64 = ctypes.POINTER(i64)
+p_i32 = ctypes.POINTER(ctypes.c_int32)
+p_int = ctypes.POINTER(ctypes.c_int)
+
+
+def _build() -> bool:
+    src = os.path.join(_DIR, "wf_native.cpp")
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(["make", "-C", _DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.wf_core_new.restype = ctypes.c_void_p
+        lib.wf_core_new.argtypes = ([i64] * 2 + [ctypes.c_int] * 2
+                                    + [i64] * 11 + [ctypes.c_int])
+        lib.wf_core_free.argtypes = [ctypes.c_void_p]
+        lib.wf_core_process.restype = i64
+        lib.wf_core_process.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        i64, i64, i64, i64, i64, i64, i64]
+        lib.wf_core_eos.restype = i64
+        lib.wf_core_eos.argtypes = [ctypes.c_void_p]
+        lib.wf_launch_peek.restype = ctypes.c_int
+        lib.wf_launch_peek.argtypes = [ctypes.c_void_p, p_i64, p_i64, p_i64,
+                                       p_int, p_int, p_i64, p_i64]
+        lib.wf_launch_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       p_i64, p_i32, p_i32, p_i32,
+                                       p_i64, p_i64, p_i64, p_i64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

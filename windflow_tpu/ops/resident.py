@@ -39,28 +39,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .device import _bucket
+from .monoid import identity as _identity
+
 #: process-wide compiled-step cache (executors are per-pattern-instance,
 #: the executables they compile should outlive them)
 _STEP_CACHE = {}
 
 _REDUCE_OPS = ("sum", "min", "max", "prod")
-
-
-def _bucket(n: int, lo: int = 8) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
-
-
-def _identity(op: str, dtype: np.dtype):
-    if op == "min":
-        return (np.iinfo(dtype).max if np.issubdtype(dtype, np.integer)
-                else np.inf)
-    if op == "max":
-        return (np.iinfo(dtype).min if np.issubdtype(dtype, np.integer)
-                else -np.inf)
-    return 1 if op == "prod" else 0
 
 
 def _make_step(key):
@@ -98,8 +84,9 @@ class ResidentWindowExecutor:
     The caller fully specifies each dispatch (rectangle, offsets, window
     descriptors in ring coordinates); this class handles shape bucketing,
     dtype narrowing/widening, the ring array's lifetime, and asynchronous
-    result harvest.  ``op`` is one of sum/mean/min/max ("count" needs no
-    device work — the host core answers it from window lengths).
+    result harvest.  ``op`` is one of sum/min/max/prod ("count" needs no
+    device work — the host core answers it from window lengths; "mean" is
+    answered by the segment-restaging path, ops/device.py).
     """
 
     def __init__(self, op: str, device=None, depth: int = 8,
@@ -134,21 +121,25 @@ class ResidentWindowExecutor:
 
     # ------------------------------------------------------------- dispatch
 
-    @staticmethod
-    def narrow(vals: np.ndarray) -> np.dtype:
-        """Narrowest wire dtype holding `vals` exactly (ints narrow to
-        int8/int16/int32; floats ship as float32)."""
+    def narrow(self, vals: np.ndarray) -> np.dtype:
+        """Narrowest wire dtype holding `vals` exactly, capped by the
+        accumulate dtype: ints narrow to int8/int16/int32 (int64 allowed
+        when accumulating in a 64-bit dtype); floats ship in the
+        accumulate precision."""
+        wide = self.acc_dtype.itemsize >= 8
         if vals.dtype.kind == "f":
-            return np.dtype(np.float32)
+            return np.dtype(np.float64 if wide else np.float32)
         if not len(vals):
             return np.dtype(np.int8)
         lo, hi = int(vals.min()), int(vals.max())
-        for dt in (np.int8, np.int16, np.int32):
+        ladder = (np.int8, np.int16, np.int32, np.int64) if wide else \
+                 (np.int8, np.int16, np.int32)
+        for dt in ladder:
             info = np.iinfo(dt)
             if info.min <= lo and hi <= info.max:
                 return np.dtype(dt)
-        return np.dtype(np.int32)  # accumulate dtype ceiling (wraps warn
-        # upstream, matching device.py's int64→int32 policy)
+        return np.dtype(ladder[-1])  # wraps; the core warned at
+        # construction when the result dtype exceeds the accumulate dtype
 
     def launch(self, meta, blk: np.ndarray, offs: np.ndarray,
                wrows: np.ndarray, wstarts: np.ndarray, wlens: np.ndarray):

@@ -1,0 +1,186 @@
+"""Native-backed resident window core: C++ bookkeeping + device ring.
+
+Same contract as ``ResidentWinSeqCore`` (process/flush producing result
+batches), but the per-row window bookkeeping and staging-rectangle assembly
+run in ``native/wf_native.cpp`` with the GIL released — the C++ hot loop the
+reference runs per tuple (win_seq.hpp:268-474), feeding the same
+``ResidentWindowExecutor`` device path.  Falls back to the pure-Python core
+transparently when the payload field is not int64 (the native ABI ships one
+int64 column) or the native library cannot be built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..core.tuples import MARKER_FIELD, Schema
+from ..core.windows import PatternConfig, Role, WindowSpec, WinType
+from ..ops.functions import Reducer
+
+_ROLE_CODE = {Role.SEQ: 0, Role.PLQ: 1, Role.WLQ: 2, Role.MAP: 3,
+              Role.REDUCE: 4}
+_WIRE_DTYPES = (np.int8, np.int16, np.int32, np.int64)
+
+
+class NativeResidentCore:
+    """Drop-in for ResidentWinSeqCore with the hot loop in C++."""
+
+    def __init__(self, spec: WindowSpec, reducer: Reducer,
+                 batch_len: int = 8192, flush_rows: int = 1 << 20,
+                 config: PatternConfig = None, role: Role = Role.SEQ,
+                 map_indexes=(0, 1), result_ts_slide=None, device=None,
+                 depth: int = 8, compute_dtype=None):
+        from ..native import load
+        from ..ops.resident import ResidentWindowExecutor
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if not isinstance(reducer, Reducer):
+            raise TypeError("native resident core needs a builtin Reducer")
+        self.spec = spec
+        self.reducer = reducer
+        self.field = reducer.field
+        self.out_field = reducer.out_field
+        self.config = config or PatternConfig.plain(spec.slide_len)
+        self.role = role
+        self.map_indexes = map_indexes
+        self.result_ts_slide = (result_ts_slide if result_ts_slide is not None
+                                else spec.slide_len)
+        self.result_schema = Schema(**reducer.result_fields)
+        self._result_dtype = self.result_schema.dtype()
+        self._args = dict(batch_len=batch_len, flush_rows=flush_rows,
+                          config=config, role=role, map_indexes=map_indexes,
+                          result_ts_slide=result_ts_slide, device=device,
+                          depth=depth, compute_dtype=compute_dtype)
+        from .win_seq_tpu import select_acc_dtype
+        acc = select_acc_dtype(reducer, compute_dtype)
+        self.executor = ResidentWindowExecutor(reducer.op, device=device,
+                                               depth=depth, acc_dtype=acc)
+        cfg = self.config
+        self._h = self._lib.wf_core_new(
+            int(spec.win_len), int(spec.slide_len),
+            0 if spec.win_type is WinType.CB else 1, _ROLE_CODE[role],
+            int(cfg.id_outer), int(cfg.n_outer), int(cfg.slide_outer),
+            int(cfg.id_inner), int(cfg.n_inner), int(cfg.slide_inner),
+            int(map_indexes[0]), int(map_indexes[1]),
+            int(self.result_ts_slide), int(batch_len), int(flush_rows),
+            3 if acc.itemsize >= 8 else 2)
+        self._delegate = None
+        self._offsets = None
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.wf_core_free(h)
+            self._h = None
+
+    # ------------------------------------------------------------- delegate
+
+    def _fall_back(self):
+        """Switch to the pure-Python resident core (non-int64 payloads)."""
+        from .win_seq_tpu import ResidentWinSeqCore
+        self._delegate = ResidentWinSeqCore(self.spec, self.reducer,
+                                            **self._args)
+        if self._h:
+            self._lib.wf_core_free(self._h)
+            self._h = None
+        return self._delegate
+
+    def _field_offsets(self, batch):
+        if self._offsets is None:
+            f = batch.dtype.fields
+            if (self.field not in f or f[self.field][0] != np.int64
+                    or batch.dtype[MARKER_FIELD] != np.bool_):
+                return None
+            self._offsets = (batch.dtype.itemsize, f["key"][1], f["id"][1],
+                             f["ts"][1], f[MARKER_FIELD][1],
+                             f[self.field][1])
+        return self._offsets
+
+    # ------------------------------------------------------------ streaming
+
+    def process(self, batch: np.ndarray) -> np.ndarray:
+        if self._delegate is not None:
+            return self._delegate.process(batch)
+        if len(batch) == 0:
+            return np.zeros(0, dtype=self._result_dtype)
+        off = self._field_offsets(batch)
+        if off is None:
+            return self._fall_back().process(batch)
+        b = np.ascontiguousarray(batch)
+        itemsize, o_key, o_id, o_ts, o_mk, o_val = off
+        n_launch = self._lib.wf_core_process(
+            self._h, b.ctypes.data, len(b), itemsize,
+            o_key, o_id, o_ts, o_mk, o_val)
+        for _ in range(n_launch):
+            self._ship_launch()
+        return self._harvest(self.executor.poll())
+
+    def flush(self) -> np.ndarray:
+        if self._delegate is not None:
+            return self._delegate.flush()
+        n_launch = self._lib.wf_core_eos(self._h)
+        for _ in range(n_launch):
+            self._ship_launch()
+        return self._harvest(self.executor.drain())
+
+    def use_incremental(self):
+        raise TypeError("the device path is non-incremental only "
+                        "(win_seq_gpu.hpp supports NIC device functors)")
+
+    # ------------------------------------------------------- launch plumbing
+
+    def _ship_launch(self):
+        lib = self._lib
+        K = ctypes.c_longlong()
+        R = ctypes.c_longlong()
+        B = ctypes.c_longlong()
+        KP = ctypes.c_longlong()
+        cap = ctypes.c_longlong()
+        wire = ctypes.c_int()
+        rebase = ctypes.c_int()
+        if not lib.wf_launch_peek(self._h, ctypes.byref(K), ctypes.byref(R),
+                                  ctypes.byref(B), ctypes.byref(wire),
+                                  ctypes.byref(rebase), ctypes.byref(KP),
+                                  ctypes.byref(cap)):
+            return
+        K, R, B = K.value, R.value, B.value
+        blk = np.empty((K, R), dtype=_WIRE_DTYPES[wire.value])
+        offs = np.empty(K, dtype=np.int64)
+        wrows = np.empty(max(B, 1), dtype=np.int32)
+        wstarts = np.empty(max(B, 1), dtype=np.int32)
+        wlens = np.empty(max(B, 1), dtype=np.int32)
+        hkey = np.empty(max(B, 1), dtype=np.int64)
+        hid = np.empty(max(B, 1), dtype=np.int64)
+        hts = np.empty(max(B, 1), dtype=np.int64)
+        hlen = np.empty(max(B, 1), dtype=np.int64)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        p64 = ctypes.POINTER(ctypes.c_longlong)
+        lib.wf_launch_take(
+            self._h, blk.ctypes.data_as(ctypes.c_void_p),
+            offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
+            wstarts.ctypes.data_as(p32), wlens.ctypes.data_as(p32),
+            hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
+            hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64))
+        ex = self.executor
+        if rebase.value:
+            ex.reset(max(K, 1), cap.value)
+        ex.launch((hkey[:B], hid[:B], hts[:B], hlen[:B]), blk, offs,
+                  wrows[:B], wstarts[:B], wlens[:B])
+
+    def _harvest(self, harvested) -> np.ndarray:
+        if not harvested:
+            return np.zeros(0, dtype=self._result_dtype)
+        from .win_seq_tpu import finalize_window_values
+        outs = []
+        for (hkey, hid, hts, hlen), out in harvested:
+            res = np.zeros(len(out), dtype=self._result_dtype)
+            res["key"] = hkey
+            res["id"] = hid
+            res["ts"] = hts
+            res[self.out_field] = finalize_window_values(self.reducer, out,
+                                                         hlen)
+            outs.append(res)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)

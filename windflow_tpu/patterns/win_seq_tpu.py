@@ -186,6 +186,51 @@ class DeviceWinSeqCore(WinSeqCore):
                         "(win_seq_gpu.hpp supports NIC device functors)")
 
 
+def select_acc_dtype(reducer: Reducer, compute_dtype) -> np.dtype:
+    """Accumulate dtype for the resident device path: int32/float32 by
+    default (TPU-native widths), overridable via ``compute_dtype``.  Warns
+    when the reducer's result dtype exceeds the accumulate range; raises if
+    a 64-bit accumulate dtype is requested without jax x64 enabled (jax
+    would silently canonicalize the buffers back down to 32-bit)."""
+    if compute_dtype is not None:
+        acc = np.dtype(compute_dtype)
+    elif np.issubdtype(reducer.dtype, np.floating):
+        acc = np.dtype(np.float32)
+    else:
+        acc = np.dtype(np.int32)
+    if acc.itemsize >= 8:
+        import jax
+        if not jax.config.jax_enable_x64:
+            raise ValueError(
+                f"compute_dtype={acc} needs jax x64 enabled "
+                "(jax.config.update('jax_enable_x64', True)); without it "
+                "jax silently truncates device buffers to 32 bits")
+    elif reducer.dtype.itemsize > acc.itemsize:
+        import warnings
+        warnings.warn(
+            f"resident device path accumulates in {acc}; {reducer.op} "
+            "results beyond its range will wrap — pass compute_dtype "
+            "for wide ranges", stacklevel=4)
+    return acc
+
+
+def finalize_window_values(reducer: Reducer, vals: np.ndarray,
+                           lens: np.ndarray) -> np.ndarray:
+    """Shared harvest step: cast device outputs to the reducer's result
+    dtype and write the host identity over empty windows (min/max/prod
+    identities exceed narrow accumulate dtypes; sum's identity 0 is what
+    the cumsum difference already yields)."""
+    owned = vals.dtype != reducer.dtype
+    if owned:
+        vals = vals.astype(reducer.dtype)
+    if (reducer.op in ("min", "max", "prod") and len(lens)
+            and (lens == 0).any()):
+        if not owned:
+            vals = vals.copy()
+        vals[lens == 0] = reducer._identity()
+    return vals
+
+
 class ResidentWinSeqCore(WinSeqCore):
     """Window core whose archive lives in device HBM (ops/resident.py).
 
@@ -207,7 +252,7 @@ class ResidentWinSeqCore(WinSeqCore):
                  role: Role = Role.SEQ, map_indexes=(0, 1),
                  result_ts_slide=None, device=None, depth: int = 8,
                  compute_dtype=None):
-        from ..ops.resident import ResidentWindowExecutor, _identity
+        from ..ops.resident import ResidentWindowExecutor
         if not isinstance(reducer, Reducer):
             raise TypeError("resident device path needs a builtin Reducer")
         super().__init__(spec, reducer, config=config, role=role,
@@ -216,18 +261,7 @@ class ResidentWinSeqCore(WinSeqCore):
         self.reducer = reducer
         self.field = reducer.field
         self.out_field = reducer.out_field
-        if compute_dtype is not None:
-            acc = np.dtype(compute_dtype)
-        elif np.issubdtype(reducer.dtype, np.floating):
-            acc = np.dtype(np.float32)
-        else:
-            acc = np.dtype(np.int32)
-        if reducer.dtype.itemsize > acc.itemsize:
-            import warnings
-            warnings.warn(
-                f"resident device path accumulates in {acc}; {reducer.op} "
-                f"results beyond its range will wrap — pass compute_dtype "
-                "for wide ranges", stacklevel=4)
+        acc = select_acc_dtype(reducer, compute_dtype)
         self.executor = ResidentWindowExecutor(reducer.op, device=device,
                                                depth=depth, acc_dtype=acc)
         self.batch_len = batch_len
@@ -332,9 +366,9 @@ class ResidentWinSeqCore(WinSeqCore):
         if arrays:
             lo = min(a.min() for a in arrays)
             hi = max(a.max() for a in arrays)
-            probe = np.array([lo, hi])
+            probe = np.array([lo, hi], dtype=arrays[0].dtype)
         else:
-            probe = np.zeros(0)
+            probe = np.zeros(0, dtype=np.int64)
         wire = ex.narrow(probe)
         blk = np.zeros((K, max(R, 1)), dtype=wire)
         for key, r in rowmap.items():
@@ -370,19 +404,12 @@ class ResidentWinSeqCore(WinSeqCore):
 
     def _build_results(self, harvested):
         outs = []
-        res_dt = self.reducer.dtype
-        fill_empties = self.reducer.op in ("min", "max", "prod")
-        host_ident = self.reducer._identity()
         for hdr, out in harvested:
-            if out.dtype != res_dt:
-                out = out.astype(res_dt)
             off = 0
             for key, ids, ts, lens in hdr:
                 n = len(ids)
-                vals = out[off:off + n]
-                if fill_empties and len(lens) and (lens == 0).any():
-                    vals = vals.copy()
-                    vals[lens == 0] = host_ident
+                vals = finalize_window_values(self.reducer,
+                                              out[off:off + n], lens)
                 outs.append(self._make_results(key, ids, ts,
                                                {self.out_field: vals}))
                 off += n
@@ -440,12 +467,18 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                     and not (winfunc.op == "sum"
                              and np.issubdtype(winfunc.dtype, np.floating)))
     if resident:
-        return ResidentWinSeqCore(
-            spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
-            config=config, role=role, map_indexes=map_indexes,
-            result_ts_slide=result_ts_slide, device=device,
-            depth=depth if depth is not None else 8,
-            compute_dtype=compute_dtype)
+        kw = dict(batch_len=batch_len, flush_rows=flush_rows, config=config,
+                  role=role, map_indexes=map_indexes,
+                  result_ts_slide=result_ts_slide, device=device,
+                  depth=depth if depth is not None else 8,
+                  compute_dtype=compute_dtype)
+        import os
+        if os.environ.get("WF_NO_NATIVE", "") != "1":
+            from ..native import available
+            if available():
+                from .native_core import NativeResidentCore
+                return NativeResidentCore(spec, winfunc, **kw)
+        return ResidentWinSeqCore(spec, winfunc, **kw)
     return DeviceWinSeqCore(
         spec, winfunc, batch_len=batch_len, config=config, role=role,
         map_indexes=map_indexes, result_ts_slide=result_ts_slide,
