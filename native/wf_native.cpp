@@ -19,9 +19,12 @@
 // Build: `make -C native` -> libwfnative.so (loaded by windflow_tpu/native).
 
 #include <algorithm>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <mutex>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -60,6 +63,10 @@ struct KeyState {
     i64 marker_pos = NEG_INF, marker_ts = 0;
     i64 purge_pos = NEG_INF;  // purge deferred to flush (rebase invariant)
     int row = -1;             // dense ring row
+    // hot-loop threshold caches (derived from next_lwid / n_fired; kept
+    // in sync at the only sites that mutate them in the streaming path)
+    i64 next_create = 0;      // initial_id + next_lwid*slide
+    i64 fire_pos = 0;         // initial_id + n_fired*slide + win
 
     size_t live() const { return pos.size() - start; }
 
@@ -146,6 +153,8 @@ struct Core {
         st.initial_id = (role == WLQ || role == REDUCE)
                             ? init_inner : init_outer + init_inner;
         st.emit_counter = (role == MAP) ? map_idx0 : 0;
+        st.next_create = st.initial_id;
+        st.fire_pos = st.initial_id + win;
         return st;
     }
 
@@ -300,6 +309,11 @@ struct Core {
     i64 process(const u8 *base, i64 n, i64 itemsize, i64 o_key, i64 o_id,
                 i64 o_ts, i64 o_marker, i64 o_val) {
         const size_t q0 = queue.size();
+        // One sequential pass (reads stay prefetch-friendly even with
+        // interleaved keys); the per-row divisions of the closed-form
+        // firing arithmetic (core/winseq.py) are replaced by two monotone
+        // comparisons against cached create/fire position thresholds —
+        // divisions only run on the (rare) create/fire events.
         for (i64 i = 0; i < n; ++i) {
             const u8 *rp = base + i * itemsize;
             i64 key, id, tsv, val;
@@ -313,32 +327,34 @@ struct Core {
             if (pos < st.last_pos) continue;       // out-of-order drop
             st.last_pos = pos;
             if (pos < st.initial_id) continue;     // before worker's slice
-            const i64 rel = pos - st.initial_id;
-            if (hopping && !mk && (rel % slide) >= win) continue;  // gap
             if (mk) {
                 st.marker_pos = pos;
                 st.marker_ts = tsv;
             } else {
+                if (hopping && ((pos - st.initial_id) % slide) >= win)
+                    continue;                      // hopping gap
                 st.pos.push_back(pos);
                 st.ts.push_back(tsv);
                 st.val.push_back(val);
                 st.appended++;
                 pend_rows++;
             }
-            const i64 last_w =
-                hopping ? rel / slide : (rel + slide) / slide - 1;
-            if (last_w + 1 > st.next_lwid) st.next_lwid = last_w + 1;
-            const i64 n_fireable =
-                (rel >= win) ? (rel - win) / slide + 1 : 0;
-            const i64 to =
-                std::min(std::max(n_fireable, st.n_fired), st.next_lwid);
-            if (to > st.n_fired) {
+            if (pos >= st.next_create) {           // lazy window creation
+                st.next_lwid = (pos - st.initial_id) / slide + 1;
+                st.next_create = st.next_lwid * slide + st.initial_id;
+            }
+            if (pos >= st.fire_pos) {              // triggerer fired
+                i64 to = (pos - st.initial_id - win) / slide + 1;
+                if (to > st.next_lwid) to = st.next_lwid;
                 const i64 from = st.n_fired;
                 st.n_fired = to;
+                st.fire_pos = to * slide + win + st.initial_id;
                 emit_windows(st, key, from, to, false);
+                if ((i64)hkey.size() >= batch_len) flush();
             }
-            if ((i64)hkey.size() >= batch_len || pend_rows >= flush_rows)
-                flush();
+            // rows-only flush: giant windows accumulate rows long before
+            // any fire event; ship bounded rectangles regardless
+            if (pend_rows >= flush_rows) flush();
         }
         return (i64)(queue.size() - q0);
     }
@@ -358,9 +374,93 @@ struct Core {
     }
 };
 
+// ---------------------------------------------------------------------------
+// Blocking MPSC channel — the FastFlow-queue analog for the threaded engine
+// (runtime/engine.py's Inbox).  Carries (src_slot, payload_slot) int pairs;
+// the Python side keeps the actual batch objects in a side table keyed by
+// payload_slot, so no Python object crosses the ABI.  Blocking push/pop run
+// with the GIL released (ctypes), replacing the 50 ms polling loops of the
+// queue.Queue fallback with futex waits.  close() is the failure path: it
+// wakes everyone; pushes fail immediately, pops drain what is left first.
+// ---------------------------------------------------------------------------
+
+struct NativeQueue {
+    std::vector<std::pair<i64, i64>> buf;
+    size_t cap, head = 0, count = 0;
+    std::mutex mu;
+    std::condition_variable cv_space, cv_items;
+    bool closed = false;
+    int waiters = 0;   // threads inside push/pop; free() spins on 0
+
+    explicit NativeQueue(size_t c) : buf(c), cap(c) {}
+
+    int push(i64 src, i64 slot) {
+        std::unique_lock<std::mutex> lk(mu);
+        ++waiters;
+        cv_space.wait(lk, [&] { return count < cap || closed; });
+        --waiters;
+        if (closed) return -1;
+        buf[(head + count) % cap] = {src, slot};
+        ++count;
+        cv_items.notify_one();
+        return 0;
+    }
+
+    int pop(i64 *src, i64 *slot) {
+        std::unique_lock<std::mutex> lk(mu);
+        ++waiters;
+        cv_items.wait(lk, [&] { return count > 0 || closed; });
+        --waiters;
+        if (count == 0) return -1;  // closed and drained
+        auto &e = buf[head];
+        *src = e.first;
+        *slot = e.second;
+        head = (head + 1) % cap;
+        --count;
+        cv_space.notify_one();
+        return 0;
+    }
+
+    void close() {
+        std::lock_guard<std::mutex> lk(mu);
+        closed = true;
+        cv_space.notify_all();
+        cv_items.notify_all();
+    }
+
+    bool idle() {
+        std::lock_guard<std::mutex> lk(mu);
+        return waiters == 0;
+    }
+};
+
 }  // namespace
 
 extern "C" {
+
+void *wf_queue_new(i64 capacity) {
+    return new NativeQueue((size_t)(capacity > 0 ? capacity : 1 << 16));
+}
+
+void wf_queue_free(void *h) {
+    // destroying a mutex/condvar another thread is blocked on is undefined
+    // behavior: close() wakes everyone, then spin until the last waiter has
+    // left push/pop before deleting
+    NativeQueue *q = (NativeQueue *)h;
+    q->close();
+    while (!q->idle()) std::this_thread::yield();
+    delete q;
+}
+
+int wf_queue_push(void *h, i64 src, i64 slot) {
+    return ((NativeQueue *)h)->push(src, slot);
+}
+
+int wf_queue_pop(void *h, i64 *src, i64 *slot) {
+    return ((NativeQueue *)h)->pop(src, slot);
+}
+
+void wf_queue_close(void *h) { ((NativeQueue *)h)->close(); }
 
 void *wf_core_new(i64 win, i64 slide, int win_type, int role,
                   i64 id_outer, i64 n_outer, i64 slide_outer,

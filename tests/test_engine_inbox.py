@@ -1,0 +1,79 @@
+"""Engine channel tests run against BOTH inbox implementations: the native
+C++ blocking ring (default when the toolchain is present) and the Python
+queue fallback — failure propagation, backpressure, and EOS draining must be
+identical."""
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.patterns.basic import Map, Sink, Source
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import build_pipeline
+
+SCHEMA = Schema(value=np.int64)
+
+
+@pytest.fixture(params=["native", "python"])
+def inbox_kind(request, monkeypatch):
+    if request.param == "python":
+        monkeypatch.setenv("WF_NO_NATIVE", "1")
+    else:
+        from windflow_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        monkeypatch.delenv("WF_NO_NATIVE", raising=False)
+    return request.param
+
+
+def make_batches(n=1000, chunk=100):
+    return [batch_from_columns(
+        SCHEMA, key=np.zeros(chunk), id=np.arange(lo, lo + chunk),
+        ts=np.arange(lo, lo + chunk),
+        value=np.arange(lo, lo + chunk)) for lo in range(0, n, chunk)]
+
+
+def test_pipeline_runs_and_sums(inbox_kind):
+    got = [0]
+
+    def consume(rows):
+        if rows is not None and len(rows):
+            got[0] += int(rows["value"].sum())
+
+    df = Dataflow(capacity=4)
+    build_pipeline(df, [
+        Source(batches=make_batches(), schema=SCHEMA),
+        Map(lambda b: b, name="identity"),
+        Sink(consume, vectorized=True)])
+    df.run_and_wait_end()
+    assert got[0] == sum(range(1000))
+
+
+def test_failing_sink_does_not_deadlock(inbox_kind):
+    def consume(rows):
+        raise RuntimeError("sink boom")
+
+    df = Dataflow(capacity=2)  # tight: producers must be unblocked
+    build_pipeline(df, [
+        Source(batches=make_batches(4000, 50), schema=SCHEMA),
+        Sink(consume, vectorized=True)])
+    with pytest.raises(RuntimeError, match="sink boom"):
+        df.run_and_wait_end()
+
+
+def test_failing_middle_stage_unblocks_producer(inbox_kind):
+    calls = [0]
+
+    def boom(b):
+        calls[0] += 1
+        if calls[0] >= 3:
+            raise ValueError("map boom")
+        return b
+
+    df = Dataflow(capacity=2)
+    build_pipeline(df, [
+        Source(batches=make_batches(8000, 50), schema=SCHEMA),
+        Map(boom, name="boom", vectorized=True),
+        Sink(lambda rows: None, vectorized=True)])
+    with pytest.raises(ValueError, match="map boom"):
+        df.run_and_wait_end()

@@ -68,9 +68,26 @@ def load():
         lib.wf_launch_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                        p_i64, p_i32, p_i32, p_i32,
                                        p_i64, p_i64, p_i64, p_i64]
+        lib.wf_queue_new.restype = ctypes.c_void_p
+        lib.wf_queue_new.argtypes = [i64]
+        lib.wf_queue_free.argtypes = [ctypes.c_void_p]
+        lib.wf_queue_push.restype = ctypes.c_int
+        lib.wf_queue_push.argtypes = [ctypes.c_void_p, i64, i64]
+        lib.wf_queue_pop.restype = ctypes.c_int
+        lib.wf_queue_pop.argtypes = [ctypes.c_void_p, p_i64, p_i64]
+        lib.wf_queue_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+def enabled():
+    """The native library, or None when unavailable or opted out via
+    WF_NO_NATIVE=1 — the single selection gate for every native-vs-Python
+    choice (cores, engine channels)."""
+    if os.environ.get("WF_NO_NATIVE", "") == "1":
+        return None
+    return load()

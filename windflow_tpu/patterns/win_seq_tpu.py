@@ -472,12 +472,10 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                   result_ts_slide=result_ts_slide, device=device,
                   depth=depth if depth is not None else 8,
                   compute_dtype=compute_dtype)
-        import os
-        if os.environ.get("WF_NO_NATIVE", "") != "1":
-            from ..native import available
-            if available():
-                from .native_core import NativeResidentCore
-                return NativeResidentCore(spec, winfunc, **kw)
+        from ..native import enabled
+        if enabled() is not None:
+            from .native_core import NativeResidentCore
+            return NativeResidentCore(spec, winfunc, **kw)
         return ResidentWinSeqCore(spec, winfunc, **kw)
     return DeviceWinSeqCore(
         spec, winfunc, batch_len=batch_len, config=config, role=role,

@@ -46,6 +46,32 @@ class KeyedStreamState:
             return batch
         keys = batch["key"]
         pos = batch[self.pos_field].astype(np.int64)
+        # fast path: per-key nondecreasing (the overwhelmingly common case
+        # for in-order streams) — one grouped monotonicity check, no
+        # per-key Python loop
+        order = np.argsort(keys, kind="stable")
+        ks = keys[order]
+        ps = pos[order]
+        starts = np.concatenate(([0], np.flatnonzero(np.diff(ks)) + 1))
+        same_key = np.ones(len(ks), dtype=bool)
+        same_key[starts] = False
+        in_order = not np.any((np.diff(ps) < 0) & same_key[1:])
+        if in_order:
+            firsts = ps[starts]
+            lasts_idx = np.concatenate((starts[1:], [len(ks)])) - 1
+            ok_heads = True
+            for i, s in enumerate(starts):
+                k = int(ks[s])
+                prev = self.last.get(k)
+                if prev is not None and firsts[i] < prev[0]:
+                    ok_heads = False
+                    break
+            if ok_heads:
+                for i, li in enumerate(lasts_idx):
+                    sel = order[li]
+                    self.last[int(ks[li])] = (int(ps[li]), batch[sel].copy())
+                return batch
+        # slow path: genuine out-of-order rows — per-key running max
         keep = np.ones(len(batch), dtype=bool)
         for k in np.unique(keys):
             m = keys == k

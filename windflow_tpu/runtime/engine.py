@@ -61,6 +61,76 @@ class Inbox:
     def get(self):
         return self._blocking(lambda: self._q.get(timeout=0.05))
 
+    def cancel(self):
+        """Failure path: wake any blocked producer/consumer (the Python
+        queue relies on the 50 ms poll; the native ring wakes instantly)."""
+
+
+class NativeInbox:
+    """Inbox over the C++ blocking ring (native/wf_native.cpp NativeQueue):
+    blocking push/pop wait on a futex with the GIL released instead of the
+    Python queue's 50 ms timeout polling.  Batch objects never cross the
+    ABI — they sit in a side table keyed by the slot id the ring carries
+    (the payload-pointer discipline of FastFlow's SPSC queues)."""
+
+    def __init__(self, capacity: int, failed: threading.Event = None,
+                 lib=None):
+        self._lib = lib
+        self._h = lib.wf_queue_new(capacity)
+        self._items = {}
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self.n_sources = 0
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            # wf_queue_free closes first and spins until the last blocked
+            # thread has left push/pop before destroying the mutex
+            self._lib.wf_queue_free(h)
+            self._h = None
+
+    def register_source(self) -> int:
+        slot = self.n_sources
+        self.n_sources += 1
+        return slot
+
+    def _push(self, src: int, item):
+        with self._seq_lock:
+            self._seq += 1
+            slot = self._seq
+        self._items[slot] = item
+        if self._lib.wf_queue_push(self._h, src, slot) != 0:
+            self._items.pop(slot, None)
+            raise _Cancelled()
+
+    def put(self, src: int, item):
+        self._push(src, item)
+
+    def put_eos(self, src: int):
+        self._push(src, _EOS)
+
+    def get(self):
+        import ctypes
+        src = ctypes.c_longlong()
+        slot = ctypes.c_longlong()
+        if self._lib.wf_queue_pop(self._h, ctypes.byref(src),
+                                  ctypes.byref(slot)) != 0:
+            raise _Cancelled()
+        return src.value, self._items.pop(slot.value)
+
+    def cancel(self):
+        self._lib.wf_queue_close(self._h)
+
+
+def _make_inbox(capacity: int, failed: threading.Event):
+    if capacity > 0:  # capacity 0 = unbounded, which only the Python
+        from ..native import enabled  # queue implements
+        lib = enabled()
+        if lib is not None:
+            return NativeInbox(capacity, failed, lib=lib)
+    return Inbox(capacity, failed)
+
 
 class Dataflow:
     """A graph of nodes executed by one thread per node
@@ -88,7 +158,7 @@ class Dataflow:
         if ctx is not None:
             node.ctx = ctx
         self.nodes.append(node)
-        self._inboxes[id(node)] = Inbox(self.capacity, self._failed)
+        self._inboxes[id(node)] = _make_inbox(self.capacity, self._failed)
         return node
 
     def connect(self, src: Node, dst: Node):
@@ -136,6 +206,8 @@ class Dataflow:
         except BaseException as e:  # propagate to run_and_wait_end
             self._errors.append(e)
             self._failed.set()  # unblock producers stuck on our inbox
+            for inbox in self._inboxes.values():
+                inbox.cancel()  # native rings wake instantly
         finally:
             try:
                 for inbox, src in node._outputs:
