@@ -70,7 +70,7 @@ def run_once(batches, schema):
     build_pipeline(df, [
         Source(batches=batches, schema=schema),
         WinSeqTPU(Reducer("sum"), WIN, SLIDE, WinType.CB,
-                  batch_len=BATCH_LEN, flush_rows=FLUSH_ROWS, depth=24),
+                  batch_len=BATCH_LEN, flush_rows=FLUSH_ROWS, depth=24, shards=4),
         Sink(consume, vectorized=True)])
     t0 = time.perf_counter()
     df.run_and_wait_end()

@@ -23,6 +23,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <functional>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -42,6 +43,17 @@ static inline i64 bucket(i64 n, i64 lo = 8) {
 static inline i64 pymod(i64 a, i64 m) {  // Python's nonnegative modulo
     i64 r = a % m;
     return r < 0 ? r + m : r;
+}
+
+// splitmix64 — the shard hash must not correlate with the farm routing
+// modulus (default_routing is key % n_workers, so a keyed-farm worker sees
+// only keys congruent mod n; sharding by key % S again would collapse
+// every row onto one shard)
+static inline unsigned long long mix64(unsigned long long x) {
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
 }
 
 namespace {
@@ -307,17 +319,26 @@ struct Core {
     }
 
     i64 process(const u8 *base, i64 n, i64 itemsize, i64 o_key, i64 o_id,
-                i64 o_ts, i64 o_marker, i64 o_val) {
+                i64 o_ts, i64 o_marker, i64 o_val,
+                i64 shard_mod = 1, i64 shard_id = 0) {
         const size_t q0 = queue.size();
         // One sequential pass (reads stay prefetch-friendly even with
         // interleaved keys); the per-row divisions of the closed-form
         // firing arithmetic (core/winseq.py) are replaced by two monotone
         // comparisons against cached create/fire position thresholds —
-        // divisions only run on the (rare) create/fire events.
+        // divisions only run on the (rare) create/fire events.  With
+        // shard_mod > 1 this core owns only keys with key %% shard_mod ==
+        // shard_id (the multithreaded key-sharded path: each shard scans
+        // the chunk and skips foreign rows — sequential bandwidth beats
+        // a scatter pass).
         for (i64 i = 0; i < n; ++i) {
             const u8 *rp = base + i * itemsize;
             i64 key, id, tsv, val;
             std::memcpy(&key, rp + o_key, 8);
+            if (shard_mod > 1
+                && (i64)(mix64((unsigned long long)key)
+                         % (unsigned long long)shard_mod) != shard_id)
+                continue;
             std::memcpy(&id, rp + o_id, 8);
             std::memcpy(&tsv, rp + o_ts, 8);
             std::memcpy(&val, rp + o_val, 8);
@@ -480,6 +501,85 @@ i64 wf_core_process(void *h, const void *base, i64 n, i64 itemsize,
                     i64 o_val) {
     return ((Core *)h)->process((const u8 *)base, n, itemsize, o_key, o_id,
                                 o_ts, o_marker, o_val);
+}
+
+// Persistent shard worker pool: threads park on a condvar between chunks
+// instead of being spawned/joined per call (the hot path runs one
+// wf_cores_process_mt per engine batch).  Leaked at process exit on
+// purpose — destroying parked threads during static teardown is riskier
+// than letting process exit reap them.
+namespace {
+
+struct ShardPool {
+    std::vector<std::thread> threads;
+    std::mutex mu;
+    std::condition_variable cv_task, cv_done;
+    const std::function<void(i64)> *job = nullptr;
+    i64 n_tasks = 0, next_task = 0, done = 0;
+    unsigned long long gen = 0;
+
+    void ensure(i64 n) {  // call with mu held
+        while ((i64)threads.size() < n) {
+            threads.emplace_back([this] { worker(); });
+        }
+    }
+
+    void worker() {
+        std::unique_lock<std::mutex> lk(mu);
+        unsigned long long seen = 0;
+        for (;;) {
+            cv_task.wait(lk, [&] { return gen != seen; });
+            seen = gen;
+            while (next_task < n_tasks) {
+                const i64 t = next_task++;
+                lk.unlock();
+                (*job)(t);
+                lk.lock();
+                if (++done == n_tasks) cv_done.notify_all();
+            }
+        }
+    }
+
+    void run(i64 n, const std::function<void(i64)> &fn) {
+        std::unique_lock<std::mutex> lk(mu);
+        ensure(n);
+        job = &fn;
+        n_tasks = n;
+        next_task = 0;
+        done = 0;
+        ++gen;
+        cv_task.notify_all();
+        cv_done.wait(lk, [&] { return done == n_tasks; });
+        job = nullptr;
+    }
+};
+
+ShardPool *shard_pool() {
+    static ShardPool *p = new ShardPool();  // intentionally never deleted
+    return p;
+}
+
+}  // namespace
+
+// Key-sharded multithreaded processing: sub-core t consumes keys with
+// mix64(key) % n_shards == t, all shards scanning the same chunk
+// concurrently on pool threads.  Returns total launches queued.
+i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
+                        i64 itemsize, i64 o_key, i64 o_id, i64 o_ts,
+                        i64 o_marker, i64 o_val) {
+    if (n_shards == 1)
+        return ((Core *)hs[0])->process((const u8 *)base, n, itemsize,
+                                        o_key, o_id, o_ts, o_marker, o_val);
+    std::vector<i64> res((size_t)n_shards, 0);
+    std::function<void(i64)> fn = [&](i64 t) {
+        res[(size_t)t] = ((Core *)hs[t])->process(
+            (const u8 *)base, n, itemsize, o_key, o_id, o_ts, o_marker,
+            o_val, n_shards, t);
+    };
+    shard_pool()->run(n_shards, fn);
+    i64 total = 0;
+    for (i64 t = 0; t < n_shards; ++t) total += res[(size_t)t];
+    return total;
 }
 
 i64 wf_core_eos(void *h) { return ((Core *)h)->eos(); }

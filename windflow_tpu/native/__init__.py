@@ -62,6 +62,10 @@ def load():
                                         i64, i64, i64, i64, i64, i64, i64]
         lib.wf_core_eos.restype = i64
         lib.wf_core_eos.argtypes = [ctypes.c_void_p]
+        lib.wf_cores_process_mt.restype = i64
+        lib.wf_cores_process_mt.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,
+            i64, i64, i64, i64, i64, i64, i64]
         lib.wf_launch_peek.restype = ctypes.c_int
         lib.wf_launch_peek.argtypes = [ctypes.c_void_p, p_i64, p_i64, p_i64,
                                        p_int, p_int, p_i64, p_i64]

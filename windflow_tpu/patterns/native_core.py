@@ -31,7 +31,7 @@ class NativeResidentCore:
                  batch_len: int = 8192, flush_rows: int = 1 << 20,
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
-                 depth: int = 8, compute_dtype=None):
+                 depth: int = 8, compute_dtype=None, shards: int = 1):
         from ..native import load
         from ..ops.resident import ResidentWindowExecutor
         self._lib = load()
@@ -56,25 +56,32 @@ class NativeResidentCore:
                           depth=depth, compute_dtype=compute_dtype)
         from .win_seq_tpu import select_acc_dtype
         acc = select_acc_dtype(reducer, compute_dtype)
-        self.executor = ResidentWindowExecutor(reducer.op, device=device,
-                                               depth=depth, acc_dtype=acc)
+        # key-sharded multithreading: shard t owns keys with key %% S == t,
+        # each with an independent sub-core, device ring, and launch queue;
+        # one GIL-released MT call processes a chunk on S host threads
+        self.shards = max(int(shards), 1)
+        self.executors = [
+            ResidentWindowExecutor(reducer.op, device=device, depth=depth,
+                                   acc_dtype=acc)
+            for _ in range(self.shards)]
+        self.executor = self.executors[0]
         cfg = self.config
-        self._h = self._lib.wf_core_new(
+        self._hs = [self._lib.wf_core_new(
             int(spec.win_len), int(spec.slide_len),
             0 if spec.win_type is WinType.CB else 1, _ROLE_CODE[role],
             int(cfg.id_outer), int(cfg.n_outer), int(cfg.slide_outer),
             int(cfg.id_inner), int(cfg.n_inner), int(cfg.slide_inner),
             int(map_indexes[0]), int(map_indexes[1]),
             int(self.result_ts_slide), int(batch_len), int(flush_rows),
-            3 if acc.itemsize >= 8 else 2)
+            3 if acc.itemsize >= 8 else 2) for _ in range(self.shards)]
+        self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
         self._delegate = None
         self._offsets = None
 
     def __del__(self):
-        h = getattr(self, "_h", None)
-        if h:
+        for h in getattr(self, "_hs", None) or ():
             self._lib.wf_core_free(h)
-            self._h = None
+        self._hs = []
 
     # ------------------------------------------------------------- delegate
 
@@ -83,9 +90,9 @@ class NativeResidentCore:
         from .win_seq_tpu import ResidentWinSeqCore
         self._delegate = ResidentWinSeqCore(self.spec, self.reducer,
                                             **self._args)
-        if self._h:
-            self._lib.wf_core_free(self._h)
-            self._h = None
+        for h in self._hs:
+            self._lib.wf_core_free(h)
+        self._hs = []
         return self._delegate
 
     def _field_offsets(self, batch):
@@ -111,20 +118,27 @@ class NativeResidentCore:
             return self._fall_back().process(batch)
         b = np.ascontiguousarray(batch)
         itemsize, o_key, o_id, o_ts, o_mk, o_val = off
-        n_launch = self._lib.wf_core_process(
-            self._h, b.ctypes.data, len(b), itemsize,
+        self._lib.wf_cores_process_mt(
+            self._harr, self.shards, b.ctypes.data, len(b), itemsize,
             o_key, o_id, o_ts, o_mk, o_val)
-        for _ in range(n_launch):
-            self._ship_launch()
-        return self._harvest(self.executor.poll())
+        harvested = []
+        for t in range(self.shards):
+            while self._ship_launch(t):
+                pass
+            harvested.extend(self.executors[t].poll())
+        return self._harvest(harvested)
 
     def flush(self) -> np.ndarray:
         if self._delegate is not None:
             return self._delegate.flush()
-        n_launch = self._lib.wf_core_eos(self._h)
-        for _ in range(n_launch):
-            self._ship_launch()
-        return self._harvest(self.executor.drain())
+        harvested = []
+        for t, h in enumerate(self._hs):
+            self._lib.wf_core_eos(h)
+            while self._ship_launch(t):
+                pass
+        for t in range(self.shards):
+            harvested.extend(self.executors[t].drain())
+        return self._harvest(harvested)
 
     def use_incremental(self):
         raise TypeError("the device path is non-incremental only "
@@ -132,8 +146,9 @@ class NativeResidentCore:
 
     # ------------------------------------------------------- launch plumbing
 
-    def _ship_launch(self):
+    def _ship_launch(self, shard: int = 0) -> bool:
         lib = self._lib
+        handle = self._hs[shard]
         K = ctypes.c_longlong()
         R = ctypes.c_longlong()
         B = ctypes.c_longlong()
@@ -141,11 +156,11 @@ class NativeResidentCore:
         cap = ctypes.c_longlong()
         wire = ctypes.c_int()
         rebase = ctypes.c_int()
-        if not lib.wf_launch_peek(self._h, ctypes.byref(K), ctypes.byref(R),
+        if not lib.wf_launch_peek(handle, ctypes.byref(K), ctypes.byref(R),
                                   ctypes.byref(B), ctypes.byref(wire),
                                   ctypes.byref(rebase), ctypes.byref(KP),
                                   ctypes.byref(cap)):
-            return
+            return False
         K, R, B = K.value, R.value, B.value
         blk = np.empty((K, R), dtype=_WIRE_DTYPES[wire.value])
         offs = np.empty(K, dtype=np.int64)
@@ -159,16 +174,17 @@ class NativeResidentCore:
         p32 = ctypes.POINTER(ctypes.c_int32)
         p64 = ctypes.POINTER(ctypes.c_longlong)
         lib.wf_launch_take(
-            self._h, blk.ctypes.data_as(ctypes.c_void_p),
+            handle, blk.ctypes.data_as(ctypes.c_void_p),
             offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
             wstarts.ctypes.data_as(p32), wlens.ctypes.data_as(p32),
             hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
             hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64))
-        ex = self.executor
+        ex = self.executors[shard]
         if rebase.value:
             ex.reset(max(K, 1), cap.value)
         ex.launch((hkey[:B], hid[:B], hts[:B], hlen[:B]), blk, offs,
                   wrows[:B], wstarts[:B], wlens[:B])
+        return True
 
     def _harvest(self, harvested) -> np.ndarray:
         if not harvested:

@@ -17,6 +17,13 @@ window by a REDUCE stage (reference win_mapreduce.hpp, wm_nodes.hpp).
 This is the streaming analog of tensor parallelism over one long window —
 the TPU mesh version computes the partials per core and the REDUCE merge as
 an on-device tree reduction over ICI (parallel/mesh.py).
+
+The reference's ``WinMap_Dropper`` (wm_nodes.hpp:137-214) has no separate
+equivalent here: it exists only to invert a ``broadcast_node`` in the
+MultiPipe CB path (multipipe.hpp:766-777, broadcast-then-keep-my-turn);
+this framework's MultiPipe composes the round-robin ``WinMapEmitter``
+directly, so the broadcast+drop pair never arises while the tuple
+assignment is identical.
 """
 
 from __future__ import annotations

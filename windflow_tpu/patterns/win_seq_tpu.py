@@ -453,7 +453,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                   role=Role.SEQ, map_indexes=(0, 1), result_ts_slide=None,
                   device=None, depth=None, use_pallas=False,
                   compute_dtype=None, use_resident=None,
-                  flush_rows=1 << 20):
+                  flush_rows=1 << 20, shards=1):
     """Choose the device core implementation: resident-archive (preferred —
     each row crosses the wire once) when the function is a built-in monoid
     the resident executor evaluates; segment-restaging otherwise."""
@@ -475,7 +475,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
         from ..native import enabled
         if enabled() is not None:
             from .native_core import NativeResidentCore
-            return NativeResidentCore(spec, winfunc, **kw)
+            return NativeResidentCore(spec, winfunc, shards=shards, **kw)
         return ResidentWinSeqCore(spec, winfunc, **kw)
     return DeviceWinSeqCore(
         spec, winfunc, batch_len=batch_len, config=config, role=role,
@@ -502,7 +502,7 @@ class WinSeqTPU(_Pattern):
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
                  depth=None, use_pallas=False, compute_dtype=None,
-                 use_resident=None, flush_rows=1 << 20):
+                 use_resident=None, flush_rows=1 << 20, shards=1):
         super().__init__(name, parallelism=1)
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self._kw = dict(batch_len=batch_len, config=config, role=role,
@@ -510,7 +510,8 @@ class WinSeqTPU(_Pattern):
                         result_ts_slide=result_ts_slide, device=device,
                         depth=depth, use_pallas=use_pallas,
                         compute_dtype=compute_dtype,
-                        use_resident=use_resident, flush_rows=flush_rows)
+                        use_resident=use_resident, flush_rows=flush_rows,
+                        shards=shards)
         self.winfunc = winfunc
 
     def make_core(self):
