@@ -512,6 +512,9 @@ namespace {
 
 struct ShardPool {
     std::vector<std::thread> threads;
+    std::mutex run_mu;  // serializes run() callers: a second engine thread
+                        // entering mid-run would overwrite job/counters and
+                        // silently drop the first caller's shard tasks
     std::mutex mu;
     std::condition_variable cv_task, cv_done;
     const std::function<void(i64)> *job = nullptr;
@@ -541,6 +544,7 @@ struct ShardPool {
     }
 
     void run(i64 n, const std::function<void(i64)> &fn) {
+        std::lock_guard<std::mutex> outer(run_mu);
         std::unique_lock<std::mutex> lk(mu);
         ensure(n);
         job = &fn;

@@ -115,3 +115,45 @@ def test_mesh_filter_semantics_count_and_mean():
                           filter_fn=lambda v: v > 0)(
         flat.astype(np.float32), starts, lens)
     np.testing.assert_allclose(mean, [[(1 + 2 + 4) / 3, (6 + 7) / 2]])
+
+
+def test_mesh_3d_window_axis():
+    """(kf=2, wf=2, sp=2): windows shard over wf, rows over sp."""
+    rng = np.random.default_rng(11)
+    flat = rng.integers(-20, 20, size=(2, 64)).astype(np.int32)
+    starts = np.stack([np.arange(8) * 7 for _ in range(2)]).astype(np.int32)
+    lens = np.full((2, 8), 9, dtype=np.int32)
+    mesh = make_mesh(2, 2, n_wf=2)
+    got = MeshWindowedReduce(mesh, op="sum")(flat, starts, lens)
+    want = np.stack([
+        [flat[g, s:s + 9].sum() for s in starts[g]] for g in range(2)])
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+def test_mesh_ring_collective_matches_psum(op):
+    """ppermute ring accumulation == the one-shot collective."""
+    rng = np.random.default_rng(13)
+    flat = rng.integers(-30, 30, size=(2, 128)).astype(np.int32)
+    starts = np.stack([np.sort(rng.integers(0, 100, size=6))
+                       for _ in range(2)]).astype(np.int32)
+    lens = rng.integers(1, 28, size=(2, 6)).astype(np.int32)
+    mesh = make_mesh(2, 4)
+    a = MeshWindowedReduce(mesh, op=op)(flat, starts, lens)
+    b = MeshWindowedReduce(mesh, op=op, collective="ring")(
+        flat, starts, lens)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_ring_mean():
+    rng = np.random.default_rng(17)
+    flat = rng.integers(0, 50, size=(1, 64)).astype(np.int32)
+    starts = np.array([[0, 10, 30]], dtype=np.int32)
+    lens = np.array([[10, 16, 20]], dtype=np.int32)
+    mesh = make_mesh(1, 8)
+    import jax.numpy as jnp
+    got = MeshWindowedReduce(mesh, op="mean", dtype=jnp.float32,
+                             collective="ring")(flat, starts, lens)
+    want = np.array([[flat[0, s:s + l].mean() for s, l in
+                      zip(starts[0], lens[0])]], dtype=np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)

@@ -170,6 +170,31 @@ def test_native_wide_values_use_int32_wire():
     assert_equal_results(host, run_core(nat, batches))
 
 
+def test_native_sharded_cores_concurrent_threads():
+    """Two sharded cores driven from two threads concurrently (two windowed
+    nodes in one pipeline): the shard pool must not mix their tasks —
+    regression for the unserialized ShardPool::run data race."""
+    import threading
+    batches = cb_stream(6, 600, chunk=50, seed=23)
+    spec = WindowSpec(16, 4, WinType.CB)
+    want = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+
+    results = [None, None]
+    def drive(i):
+        core = make_native(spec, Reducer("sum"), batch_len=32,
+                           flush_rows=120, shards=2)
+        results[i] = run_core(core, batches)
+
+    for _ in range(5):
+        ts = [threading.Thread(target=drive, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for r in results:
+            assert_equal_results(want, r)
+
+
 def test_native_hopping_gaps():
     batches = cb_stream(2, 300, chunk=41, seed=21)
     spec = WindowSpec(4, 10, WinType.CB)   # hopping: slide > win

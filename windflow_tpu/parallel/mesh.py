@@ -32,20 +32,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.device import _bucket
 
-KF_AXIS = "kf"   # key/window-group parallelism (no collectives)
+KF_AXIS = "kf"   # key/group parallelism (no collectives; Key_Farm axis)
+WF_AXIS = "wf"   # window parallelism (no collectives; Win_Farm axis)
 SP_AXIS = "sp"   # within-window partition parallelism (collectives over ICI)
 
 
-def make_mesh(n_kf: int = 1, n_sp: int = 1, devices=None) -> Mesh:
-    """A 2D (kf, sp) device mesh. ``n_kf * n_sp`` must not exceed the
-    device count; on a v5e-8 use e.g. (4, 2) or (8, 1)."""
+def make_mesh(n_kf: int = 1, n_sp: int = 1, devices=None,
+              n_wf: int = 1) -> Mesh:
+    """A 3D (kf, wf, sp) device mesh — the three streaming parallelism
+    axes of SURVEY.md §2.7 as mesh dimensions.  ``n_kf * n_wf * n_sp``
+    must not exceed the device count; on a v5e-8 use e.g. (4, 1, 2) or
+    (2, 2, 2).  On a multi-host topology put ``kf`` outermost: key groups
+    exchange nothing, so the inter-host (DCN) hops carry no collective
+    traffic — only ``sp``'s psum/ppermute rides the intra-slice ICI."""
     devices = list(devices if devices is not None else jax.devices())
-    need = n_kf * n_sp
+    need = n_kf * n_wf * n_sp
     if need > len(devices):
-        raise ValueError(f"mesh ({n_kf}x{n_sp}) needs {need} devices, "
-                         f"have {len(devices)}")
-    grid = np.asarray(devices[:need], dtype=object).reshape(n_kf, n_sp)
-    return Mesh(grid, (KF_AXIS, SP_AXIS))
+        raise ValueError(f"mesh ({n_kf}x{n_wf}x{n_sp}) needs {need} "
+                         f"devices, have {len(devices)}")
+    grid = np.asarray(devices[:need], dtype=object).reshape(
+        n_kf, n_wf, n_sp)
+    return Mesh(grid, (KF_AXIS, WF_AXIS, SP_AXIS))
 
 
 from ..ops.monoid import OPS as _OPS
@@ -75,15 +82,23 @@ class MeshWindowedReduce:
     """
 
     def __init__(self, mesh: Mesh, op: str = "sum", dtype=jnp.int32,
-                 map_fn=None, filter_fn=None):
+                 map_fn=None, filter_fn=None, collective: str = "auto"):
         if op not in _OPS:
             raise ValueError(f"unsupported op {op!r}")
+        if collective not in ("auto", "psum", "ring"):
+            raise ValueError(f"unknown collective {collective!r}")
         self.mesh = mesh
         self.op = op
         self.dtype = jnp.dtype(dtype)
         self.map_fn = map_fn
         self.filter_fn = filter_fn
+        # "ring": accumulate sp partials with n_sp-1 ppermute rotations
+        # (each hop only talks to its ICI neighbour — the communication
+        # pattern of ring attention / ring all-reduce) instead of one
+        # psum.  Same result; lets schedulers overlap hops with compute.
+        self.collective = collective
         self.n_kf = mesh.shape[KF_AXIS]
+        self.n_wf = mesh.shape.get(WF_AXIS, 1)
         self.n_sp = mesh.shape[SP_AXIS]
         self._jits = {}
 
@@ -100,9 +115,26 @@ class MeshWindowedReduce:
         op, dtype = self.op, self.dtype
         map_fn, filter_fn = self.map_fn, self.filter_fn
         ident = _identity(op, dtype)
+        n_sp = self.n_sp
+        ring = self.collective == "ring" and n_sp > 1
+        ufunc = {"sum": jnp.add, "count": jnp.add, "mean": jnp.add,
+                 "min": jnp.minimum, "max": jnp.maximum,
+                 "prod": jnp.multiply}[op]
+
+        def ring_combine(x):
+            # accumulate the sp partials with n_sp-1 neighbour rotations
+            # (ring all-reduce / ring-attention communication pattern):
+            # each hop is one ICI ppermute to the next shard
+            perm = [(i, (i + 1) % n_sp) for i in range(n_sp)]
+            acc = x
+            for _ in range(n_sp - 1):
+                x = jax.lax.ppermute(x, SP_AXIS, perm)
+                acc = ufunc(acc, x)
+            return acc
 
         def local(flat, starts, lens):
-            # flat: (1, Ns); starts/lens: (1, B) — one (kf, sp) shard's view
+            # flat: (1, Ns) — this sp shard's row slice, replicated over
+            # wf; starts/lens: (1, B/n_wf) — this wf shard's windows
             r = jax.lax.axis_index(SP_AXIS).astype(jnp.int32)
             base = r * Ns
             v = flat[0]
@@ -121,7 +153,14 @@ class MeshWindowedReduce:
             else:
                 vals = jnp.where(mask, v[idx], ident).astype(dtype)
                 part = jnp_reducer(op)(vals, axis=1)
-            if op in ("sum", "count"):
+            if ring:
+                if op == "mean":
+                    s = ring_combine(part)
+                    c = ring_combine(jnp.sum(mask, axis=1))
+                    out = s / jnp.maximum(c, 1).astype(dtype)
+                else:
+                    out = ring_combine(part)
+            elif op in ("sum", "count"):
                 out = jax.lax.psum(part, SP_AXIS)
             elif op == "mean":
                 s = jax.lax.psum(part, SP_AXIS)
@@ -139,12 +178,13 @@ class MeshWindowedReduce:
                 out = jnp_reducer(op)(allp, axis=0)
             return out[None, :]
 
+        wf = WF_AXIS if self.n_wf > 1 else None
         mapped = jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(P(KF_AXIS, SP_AXIS), P(KF_AXIS, None),
-                      P(KF_AXIS, None)),
-            out_specs=P(KF_AXIS, None),
-            check_vma=(op != "prod"))
+            in_specs=(P(KF_AXIS, SP_AXIS), P(KF_AXIS, wf),
+                      P(KF_AXIS, wf)),
+            out_specs=P(KF_AXIS, wf),
+            check_vma=(op != "prod" and not ring))
         fn = jax.jit(mapped)
         self._jits[key] = fn
         return fn
@@ -164,6 +204,8 @@ class MeshWindowedReduce:
             raise ValueError(f"flat has {KF} groups, mesh kf={self.n_kf}")
         B = starts.shape[1]
         Bb = _bucket(B)
+        if Bb % self.n_wf:  # the window axis shards B over wf
+            Bb = ((Bb + self.n_wf - 1) // self.n_wf) * self.n_wf
         # shard size: each sp shard gets Ns rows; pad the row axis so any
         # [start, start+pad) window fits inside one shard's clip range
         maxlen = int(lens.max()) if lens.size else 1
@@ -177,9 +219,10 @@ class MeshWindowedReduce:
         glens = np.zeros((KF, Bb), dtype=np.int32)
         glens[:, :B] = lens
 
+        wf = WF_AXIS if self.n_wf > 1 else None
         dflat = jax.device_put(gflat, self.sharding(P(KF_AXIS, SP_AXIS)))
-        dstarts = jax.device_put(gstarts, self.sharding(P(KF_AXIS, None)))
-        dlens = jax.device_put(glens, self.sharding(P(KF_AXIS, None)))
+        dstarts = jax.device_put(gstarts, self.sharding(P(KF_AXIS, wf)))
+        dlens = jax.device_put(glens, self.sharding(P(KF_AXIS, wf)))
         out = self._build(Bb, pad, Ns)(dflat, dstarts, dlens)
         return np.asarray(out)[:, :B]
 

@@ -56,9 +56,11 @@ class NativeResidentCore:
                           depth=depth, compute_dtype=compute_dtype)
         from .win_seq_tpu import select_acc_dtype
         acc = select_acc_dtype(reducer, compute_dtype)
-        # key-sharded multithreading: shard t owns keys with key %% S == t,
-        # each with an independent sub-core, device ring, and launch queue;
-        # one GIL-released MT call processes a chunk on S host threads
+        # key-sharded multithreading: shard t owns keys with
+        # mix64(key) %% S == t (a hash decorrelated from the farm routing
+        # modulus — see wf_native.cpp), each with an independent sub-core,
+        # device ring, and launch queue; one GIL-released MT call
+        # processes a chunk on S pool threads
         self.shards = max(int(shards), 1)
         self.executors = [
             ResidentWindowExecutor(reducer.op, device=device, depth=depth,
