@@ -66,7 +66,7 @@ def run_variant(variant, n_events=30000, pardegree2=4):
     return got, sink, sent
 
 
-@pytest.mark.parametrize("variant", ["kf", "wmr"])
+@pytest.mark.parametrize("variant", ["kf", "kf-tpu", "wmr"])
 def test_ysb_counts_match_oracle(variant):
     n = 30000
     got, sink, sent = run_variant(variant)
@@ -83,6 +83,14 @@ def test_ysb_counts_match_oracle(variant):
         want_cmp[c] = want_cmp.get(c, 0) + n_
     assert per_cmp == want_cmp
     assert sink.received == len(got.rows)
+
+
+def test_ysb_kf_tpu_differential():
+    """The device-path variant must produce the same windows as the host
+    KF variant (count and lastUpdate)."""
+    a, _, _ = run_variant("kf")
+    b, _, _ = run_variant("kf-tpu")
+    assert sorted(a.rows) == sorted(b.rows)
 
 
 def test_ysb_kf_wmr_differential():

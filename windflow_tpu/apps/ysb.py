@@ -88,6 +88,25 @@ class YSBReduce(WindowFunction):
                 int(rows["lastUpdate"].max()) if len(rows) else 0)
 
 
+def device_aggregate():
+    """The YSB aggregate as a device window function (count + MAX(ts) over
+    the staged ts column) — COUNT/MAX are monoids, so the whole KF stage
+    can evaluate on the TPU.  Event timestamps are relative microseconds
+    (event_batches), so the int32 device staging is exact for runs under
+    ~35 minutes."""
+    import jax.numpy as jnp
+
+    from ..patterns.win_seq_tpu import JaxWindowFunction
+
+    def fn(keys, gwids, cols, mask):
+        return (jnp.sum(mask, axis=1),
+                jnp.max(jnp.where(mask, cols["ts"], 0), axis=1))
+
+    return JaxWindowFunction(fn, fields=("ts",),
+                             result_fields={"count": np.int64,
+                                            "lastUpdate": np.int64})
+
+
 def event_batches(duration_sec: float, chunk: int, campaigns,
                   time_fn=time.monotonic):
     """Generator of event batches at full speed for `duration_sec`
@@ -167,6 +186,13 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
     if variant == "kf":
         agg = KeyFarm(YSBAggregate(), win_us, win_us, WinType.TB,
                       pardegree=pardegree2, name="ysb_kf")
+    elif variant == "kf-tpu":
+        # the tracked yahoo_test_tpu config: the window stage evaluates on
+        # the device (DeviceWinSeqCore over the JAX aggregate)
+        from ..patterns.win_seq_tpu import KeyFarmTPU
+        agg = KeyFarmTPU(device_aggregate(), win_us, win_us, WinType.TB,
+                         pardegree=pardegree2, batch_len=256,
+                         compute_dtype=np.int32, name="ysb_kf_tpu")
     elif variant == "wmr":
         agg = WinMapReduce(YSBAggregate(), YSBReduce(), win_us, win_us,
                            WinType.TB, map_degree=max(pardegree2, 2),
@@ -211,7 +237,8 @@ def main(argv=None):
                     help="generation time seconds (reference -l)")
     ap.add_argument("-p", "--pardegree1", type=int, default=1)
     ap.add_argument("-w", "--pardegree2", type=int, default=4)
-    ap.add_argument("--variant", choices=["kf", "wmr"], default="kf")
+    ap.add_argument("--variant", choices=["kf", "kf-tpu", "wmr"],
+                    default="kf")
     ap.add_argument("--win-sec", type=float, default=10.0)
     ap.add_argument("--chunk", type=int, default=65536)
     a = ap.parse_args(argv)

@@ -40,6 +40,15 @@ NP_UFUNCS = {
 }
 
 
+def jnp_ufunc(op: str):
+    """The jax.numpy pairwise combiner for `op` (count combines like sum —
+    partial counts add)."""
+    import jax.numpy as jnp
+    return {"sum": jnp.add, "count": jnp.add, "mean": jnp.add,
+            "min": jnp.minimum, "max": jnp.maximum,
+            "prod": jnp.multiply}[op]
+
+
 def jnp_reducer(op: str):
     """The jax.numpy whole-axis reducer for `op` (mean/count handled by the
     callers from masks)."""

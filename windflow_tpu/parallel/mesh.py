@@ -15,11 +15,16 @@ strategies (SURVEY.md §2.7) become mesh axes:
   reduce — the REDUCE stage).  This is the streaming analog of sequence
   parallelism over one long context.
 
-The combination is a 2D mesh: a (kf=4, sp=2) mesh runs 4 key groups, each
-evaluating its windows split over 2 chips.  Everything is jitted once per
-shape bucket (powers of two, like ops/device.py) and executed as one SPMD
-program — the XLA-native replacement for the reference's per-worker CUDA
-streams.
+* ``wf`` axis — **window parallelism** (Win_Farm round-robin window
+  assignment, wf_nodes.hpp:158-173): the fired-window descriptors shard
+  over ``wf``; every shard evaluates its window subset over the (replicated)
+  group rows.  No collectives.
+
+The combination is a 3D mesh: a (kf=2, wf=2, sp=2) mesh runs 2 key groups,
+each splitting its windows over 2 chips, each window's rows over 2 chips —
+the three SURVEY §2.7 streaming decompositions as one SPMD program, jitted
+once per shape bucket (powers of two, like ops/device.py).  The sp merge
+runs as one psum or as a ring of ICI ppermute hops (``collective="ring"``).
 """
 
 from __future__ import annotations
@@ -67,10 +72,12 @@ class MeshWindowedReduce:
     Global layout (KF = kf-shards, each owning B windows over N rows):
 
     * ``flat``  (KF, N) sharded ``P(kf, sp)`` — each sp shard holds a
-      contiguous N/sp row slice of each group's archive segment;
-    * ``starts``/``lens`` (KF, B) sharded ``P(kf, None)`` — window
-      descriptors, replicated over sp (tiny);
-    * result (KF, B) sharded ``P(kf, None)`` — every window's reduction,
+      contiguous N/sp row slice of each group's archive segment,
+      replicated over wf;
+    * ``starts``/``lens`` (KF, B) sharded ``P(kf, wf)`` — window
+      descriptors split over the window axis (``P(kf, None)`` when
+      n_wf == 1), replicated over sp;
+    * result (KF, B) sharded ``P(kf, wf)`` — every window's reduction,
       identical on all sp shards after the collective.
 
     Optional fused elementwise stages ride the same kernel (the device-side
@@ -117,9 +124,8 @@ class MeshWindowedReduce:
         ident = _identity(op, dtype)
         n_sp = self.n_sp
         ring = self.collective == "ring" and n_sp > 1
-        ufunc = {"sum": jnp.add, "count": jnp.add, "mean": jnp.add,
-                 "min": jnp.minimum, "max": jnp.maximum,
-                 "prod": jnp.multiply}[op]
+        from ..ops.monoid import jnp_ufunc
+        ufunc = jnp_ufunc(op)
 
         def ring_combine(x):
             # accumulate the sp partials with n_sp-1 neighbour rotations
