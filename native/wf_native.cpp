@@ -129,6 +129,11 @@ struct Core {
 
     i64 KP = 0, cap = 0;              // current ring geometry
     std::deque<Launch> queue;
+    std::mutex qmu;  // producer (process/eos on the node thread) vs
+                     // consumer (wf_launch_peek/take on a ship thread)
+    i64 launches_made = 0;  // produced-launch counter; only the producer
+                            // thread reads/writes it (queue.size() is
+                            // not safe to read unlocked)
 
     Core(i64 win_, i64 slide_, int kind_, int role_,
          i64 io, i64 no, i64 so, i64 ii, i64 ni, i64 si,
@@ -311,7 +316,11 @@ struct Core {
         L.hts = std::move(hts);
         L.K = K; L.R = Rr; L.B = B; L.KP = KP; L.cap = cap;
         L.rebase = rebase ? 1 : 0;
-        queue.push_back(std::move(L));
+        {
+            std::lock_guard<std::mutex> lk(qmu);
+            queue.push_back(std::move(L));
+        }
+        ++launches_made;
         for (auto &st : keys) st.purge();
         pend_rows = 0;
         wrow.clear(); wlo.clear(); wlen.clear();
@@ -321,7 +330,7 @@ struct Core {
     i64 process(const u8 *base, i64 n, i64 itemsize, i64 o_key, i64 o_id,
                 i64 o_ts, i64 o_marker, i64 o_val,
                 i64 shard_mod = 1, i64 shard_id = 0) {
-        const size_t q0 = queue.size();
+        const i64 q0 = launches_made;
         // One sequential pass (reads stay prefetch-friendly even with
         // interleaved keys); the per-row divisions of the closed-form
         // firing arithmetic (core/winseq.py) are replaced by two monotone
@@ -377,11 +386,11 @@ struct Core {
             // any fire event; ship bounded rectangles regardless
             if (pend_rows >= flush_rows) flush();
         }
-        return (i64)(queue.size() - q0);
+        return launches_made - q0;
     }
 
     i64 eos() {
-        const size_t q0 = queue.size();
+        const i64 q0 = launches_made;
         for (size_t r = 0; r < keys.size(); ++r) {
             KeyState &st = keys[r];
             if (st.n_fired < st.next_lwid) {
@@ -391,7 +400,7 @@ struct Core {
             }
         }
         flush();
-        return (i64)(queue.size() - q0);
+        return launches_made - q0;
     }
 };
 
@@ -588,9 +597,16 @@ i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
 
 i64 wf_core_eos(void *h) { return ((Core *)h)->eos(); }
 
+i64 wf_launch_pending(void *h) {
+    Core *c = (Core *)h;
+    std::lock_guard<std::mutex> lk(c->qmu);
+    return (i64)c->queue.size();
+}
+
 int wf_launch_peek(void *h, i64 *K, i64 *R, i64 *B, int *wire, int *rebase,
                    i64 *KP, i64 *cap) {
     Core *c = (Core *)h;
+    std::lock_guard<std::mutex> lk(c->qmu);
     if (c->queue.empty()) return 0;
     Launch &L = c->queue.front();
     *K = L.K; *R = L.R; *B = L.B; *wire = L.wire; *rebase = L.rebase;
@@ -602,6 +618,7 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
                     int32_t *wstarts, int32_t *wlens, i64 *hkey, i64 *hid,
                     i64 *hts, i64 *hlen) {
     Core *c = (Core *)h;
+    std::lock_guard<std::mutex> lk(c->qmu);
     Launch &L = c->queue.front();
     const i64 isz = 1LL << L.wire;
     std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));

@@ -170,6 +170,19 @@ def test_native_wide_values_use_int32_wire():
     assert_equal_results(host, run_core(nat, batches))
 
 
+@pytest.mark.parametrize("overlap", [True, False])
+@pytest.mark.parametrize("shards", [1, 3])
+def test_native_overlap_and_shards_match_host(overlap, shards):
+    """The ship-thread overlap mode and the synchronous mode produce
+    identical results for any shard count."""
+    batches = cb_stream(5, 400, chunk=41, seed=29)
+    spec = WindowSpec(12, 4, WinType.CB)
+    want = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    core = make_native(spec, Reducer("sum"), batch_len=32, flush_rows=120,
+                       shards=shards, overlap=overlap)
+    assert_equal_results(want, run_core(core, batches))
+
+
 def test_native_sharded_cores_concurrent_threads():
     """Two sharded cores driven from two threads concurrently (two windowed
     nodes in one pipeline): the shard pool must not mix their tasks —

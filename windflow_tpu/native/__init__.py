@@ -66,6 +66,8 @@ def load():
         lib.wf_cores_process_mt.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,
             i64, i64, i64, i64, i64, i64, i64]
+        lib.wf_launch_pending.restype = i64
+        lib.wf_launch_pending.argtypes = [ctypes.c_void_p]
         lib.wf_launch_peek.restype = ctypes.c_int
         lib.wf_launch_peek.argtypes = [ctypes.c_void_p, p_i64, p_i64, p_i64,
                                        p_int, p_int, p_i64, p_i64]

@@ -12,6 +12,10 @@ int64 column) or the native library cannot be built.
 from __future__ import annotations
 
 import ctypes
+import queue as _queue
+import threading
+import time
+import weakref
 
 import numpy as np
 
@@ -24,6 +28,20 @@ _ROLE_CODE = {Role.SEQ: 0, Role.PLQ: 1, Role.WLQ: 2, Role.MAP: 3,
 _WIRE_DTYPES = (np.int8, np.int16, np.int32, np.int64)
 
 
+def _ship_loop(core_ref, ship_q):
+    """Ship-thread main: resolves the core weakref per token so the thread
+    never pins the core's lifetime (a dead core ends the loop)."""
+    while True:
+        tok = ship_q.get()
+        if tok is None:
+            return
+        core = core_ref()
+        if core is None:
+            return
+        core._ship_token(tok)
+        del core
+
+
 class NativeResidentCore:
     """Drop-in for ResidentWinSeqCore with the hot loop in C++."""
 
@@ -31,7 +49,8 @@ class NativeResidentCore:
                  batch_len: int = 8192, flush_rows: int = 1 << 20,
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
-                 depth: int = 8, compute_dtype=None, shards: int = 1):
+                 depth: int = 8, compute_dtype=None, shards: int = 1,
+                 overlap: bool = True):
         from ..native import load
         from ..ops.resident import ResidentWindowExecutor
         self._lib = load()
@@ -79,11 +98,72 @@ class NativeResidentCore:
         self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
         self._delegate = None
         self._offsets = None
+        # overlap mode: a dedicated ship thread owns the executors —
+        # device_put/dispatch/harvest run concurrently with the next
+        # chunk's C++ bookkeeping (the C++ launch queue is mutex-guarded
+        # for this producer/consumer split)
+        self._overlap = bool(overlap)
+        self._ship_exc = None
+        #: launches allowed to pile up in the C++ queue before process()
+        #: throttles — restores the backpressure the synchronous ship loop
+        #: provided (each queued Launch holds a staged K*R block)
+        self._max_pending = 2 * depth
+        if self._overlap:
+            self._ship_q = _queue.SimpleQueue()
+            self._out_q = _queue.SimpleQueue()
+            # the thread holds only a weakref: a live ship thread must not
+            # keep the core (and its C++ heap + device rings) alive
+            self._ship_thread = threading.Thread(
+                target=_ship_loop, args=(weakref.ref(self), self._ship_q),
+                daemon=True, name="wf-ship")
+            self._ship_thread.start()
+
+    def _stop_worker(self):
+        t = getattr(self, "_ship_thread", None)
+        if t is not None and t.is_alive():
+            self._ship_q.put(None)
+            t.join(timeout=10)
+        self._ship_thread = None
 
     def __del__(self):
+        if getattr(self, "_overlap", False):
+            self._stop_worker()
         for h in getattr(self, "_hs", None) or ():
             self._lib.wf_core_free(h)
         self._hs = []
+
+    # ------------------------------------------------------------ ship thread
+
+    def _ship_token(self, tok):
+        kind, ev = tok
+        try:
+            for t in range(self.shards):
+                while self._ship_launch(t):
+                    pass
+                got = (self.executors[t].drain() if kind == "drain"
+                       else self.executors[t].poll())
+                for item in got:
+                    self._out_q.put(item)
+        except BaseException as e:  # surfaced on the node thread
+            self._ship_exc = e
+        finally:
+            if ev is not None:
+                ev.set()
+
+    def _raise_ship_exc(self):
+        """Surface a ship-thread failure after salvaging already-shipped
+        results; clears the stored exception so it is raised once."""
+        exc, self._ship_exc = self._ship_exc, None
+        raise exc
+
+    def _drain_out_q(self):
+        items = []
+        while True:
+            try:
+                items.append(self._out_q.get_nowait())
+            except _queue.Empty:
+                break
+        return items
 
     # ------------------------------------------------------------- delegate
 
@@ -92,6 +172,8 @@ class NativeResidentCore:
         from .win_seq_tpu import ResidentWinSeqCore
         self._delegate = ResidentWinSeqCore(self.spec, self.reducer,
                                             **self._args)
+        if self._overlap:
+            self._stop_worker()
         for h in self._hs:
             self._lib.wf_core_free(h)
         self._hs = []
@@ -123,6 +205,18 @@ class NativeResidentCore:
         self._lib.wf_cores_process_mt(
             self._harr, self.shards, b.ctypes.data, len(b), itemsize,
             o_key, o_id, o_ts, o_mk, o_val)
+        if self._overlap:
+            self._ship_q.put(("ship", None))
+            # backpressure: if the device path is slower than ingestion,
+            # wait for the ship thread to work the C++ queue down
+            while (self._ship_exc is None
+                   and max(self._lib.wf_launch_pending(h)
+                           for h in self._hs) > self._max_pending):
+                time.sleep(0.001)
+            out = self._harvest(self._drain_out_q())
+            if self._ship_exc is not None:
+                self._raise_ship_exc()
+            return out
         harvested = []
         for t in range(self.shards):
             while self._ship_launch(t):
@@ -133,12 +227,20 @@ class NativeResidentCore:
     def flush(self) -> np.ndarray:
         if self._delegate is not None:
             return self._delegate.flush()
-        harvested = []
-        for t, h in enumerate(self._hs):
+        for h in self._hs:
             self._lib.wf_core_eos(h)
+        if self._overlap:
+            ev = threading.Event()
+            self._ship_q.put(("drain", ev))
+            ev.wait()
+            out = self._harvest(self._drain_out_q())
+            if self._ship_exc is not None:
+                self._raise_ship_exc()
+            return out
+        harvested = []
+        for t in range(self.shards):
             while self._ship_launch(t):
                 pass
-        for t in range(self.shards):
             harvested.extend(self.executors[t].drain())
         return self._harvest(harvested)
 
