@@ -618,8 +618,14 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
                     int32_t *wstarts, int32_t *wlens, i64 *hkey, i64 *hid,
                     i64 *hts, i64 *hlen) {
     Core *c = (Core *)h;
-    std::lock_guard<std::mutex> lk(c->qmu);
-    Launch &L = c->queue.front();
+    Launch L;
+    {
+        // move the launch out under the lock; the (potentially multi-MB)
+        // copies below must not stall the producer's flush() push
+        std::lock_guard<std::mutex> lk(c->qmu);
+        L = std::move(c->queue.front());
+        c->queue.pop_front();
+    }
     const i64 isz = 1LL << L.wire;
     std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));
     std::memcpy(offs, L.offs.data(), (size_t)L.K * 8);
@@ -632,7 +638,6 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
         std::memcpy(hts, L.hts.data(), (size_t)L.B * 8);
         std::memcpy(hlen, L.hlen.data(), (size_t)L.B * 8);
     }
-    c->queue.pop_front();
 }
 
 }  // extern "C"

@@ -97,3 +97,27 @@ def test_micro_pipeline_counters():
     # Filter keeps value*3 even <=> original value even: exactly half
     assert m["received"] == m["sent"] // 2
     assert m["avg_latency_us"] >= 0
+
+
+def test_spatial_device_skyline_matches_host():
+    """The skyline as an arbitrary JAX window function on the device path
+    (WinSeqTPU / WinFarmTPU) must match the host skyline.  Coordinates are
+    quantized to a 1/256 grid so the device's float32 compute is exact."""
+    from windflow_tpu.apps.spatial import device_skyline
+    from windflow_tpu.patterns.win_seq_tpu import WinFarmTPU, WinSeqTPU
+
+    def quantize(b):
+        b = b.copy()
+        b["x"] = np.round(b["x"] * 256) / 256
+        b["y"] = np.round(b["y"] * 256) / 256
+        return b
+
+    batches = [quantize(b) for b in point_batches(300, keys=2)]
+    host = run_spatial(WinSeq(SkylineWindow(), WIN, SLIDE, WinType.TB),
+                       batches)
+    dev = run_spatial(WinSeqTPU(device_skyline(), WIN, SLIDE, WinType.TB,
+                                batch_len=16), batches)
+    assert host == dev
+    farm = run_spatial(WinFarmTPU(device_skyline(), WIN, SLIDE, WinType.TB,
+                                  pardegree=2, batch_len=8), batches)
+    assert host == farm

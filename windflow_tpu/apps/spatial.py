@@ -82,6 +82,34 @@ class SkylineWLQ(WindowFunction):
         return (len(sk), float(sk.sum()))
 
 
+def device_skyline():
+    """The skyline as a *device* window function — the showcase for
+    arbitrary JAX window functions (JaxWindowFunction): the O(n^2)
+    dominance test runs as one masked (B, pad, pad) comparison on the
+    VPU, all windows of the batch at once.  Note device floats compute in
+    float32 (jax default); exact parity with the host float64 skyline
+    needs float32-representable coordinates (the tests use a 1/256 grid).
+    """
+    import jax.numpy as jnp
+
+    from ..patterns.win_seq_tpu import JaxWindowFunction
+
+    def fn(keys, gwids, cols, mask):
+        x, y = cols["x"], cols["y"]                       # (B, pad)
+        le = ((x[:, None, :] <= x[:, :, None])
+              & (y[:, None, :] <= y[:, :, None]))         # j <= i per dim
+        lt = ((x[:, None, :] < x[:, :, None])
+              | (y[:, None, :] < y[:, :, None]))
+        dom = le & lt & mask[:, None, :]                  # j must be real
+        alive = mask & ~jnp.any(dom, axis=2)
+        size = jnp.sum(alive, axis=1)
+        checksum = jnp.sum(jnp.where(alive, x + y, 0.0), axis=1)
+        return size, checksum
+
+    return JaxWindowFunction(fn, fields=("x", "y"),
+                             result_fields=dict(RESULT_FIELDS))
+
+
 def point_batches(n_points, keys=1, chunk=512, seed=7, ts_step=5):
     """Synthetic point stream (sq_generator.hpp analog): uniform points
     with a linear timestamp ramp per key."""

@@ -98,6 +98,8 @@ class NativeResidentCore:
         self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
         self._delegate = None
         self._offsets = None
+        self._salvaged = []  # results drained during a raise, returned to
+                             # a caller that catches and keeps going
         # overlap mode: a dedicated ship thread owns the executors —
         # device_put/dispatch/harvest run concurrently with the next
         # chunk's C++ bookkeeping (the C++ launch queue is mutex-guarded
@@ -150,9 +152,12 @@ class NativeResidentCore:
             if ev is not None:
                 ev.set()
 
-    def _raise_ship_exc(self):
-        """Surface a ship-thread failure after salvaging already-shipped
-        results; clears the stored exception so it is raised once."""
+    def _raise_ship_exc(self, drained):
+        """Surface a ship-thread failure; results already drained are
+        stashed and returned by the next successful call, so a caller that
+        catches the error and keeps streaming does not lose windows.
+        Clears the stored exception so it is raised once."""
+        self._salvaged.extend(drained)
         exc, self._ship_exc = self._ship_exc, None
         raise exc
 
@@ -213,10 +218,11 @@ class NativeResidentCore:
                    and max(self._lib.wf_launch_pending(h)
                            for h in self._hs) > self._max_pending):
                 time.sleep(0.001)
-            out = self._harvest(self._drain_out_q())
+            drained = self._drain_out_q()
             if self._ship_exc is not None:
-                self._raise_ship_exc()
-            return out
+                self._raise_ship_exc(drained)
+            out, self._salvaged = self._salvaged + drained, []
+            return self._harvest(out)
         harvested = []
         for t in range(self.shards):
             while self._ship_launch(t):
@@ -233,10 +239,11 @@ class NativeResidentCore:
             ev = threading.Event()
             self._ship_q.put(("drain", ev))
             ev.wait()
-            out = self._harvest(self._drain_out_q())
+            drained = self._drain_out_q()
             if self._ship_exc is not None:
-                self._raise_ship_exc()
-            return out
+                self._raise_ship_exc(drained)
+            out, self._salvaged = self._salvaged + drained, []
+            return self._harvest(out)
         harvested = []
         for t in range(self.shards):
             while self._ship_launch(t):
