@@ -18,8 +18,10 @@ Differences, per the framework's batch idiom:
   the FlatMap's "drop on miss" arm never fires (same as the reference's
   generated workload);
 * the aggregate (yahoo_app.hpp:150-156: ``count++``, ``lastUpdate =
-  max(ts)``) is one vectorised window function usable as the KF stage, the
-  WMR MAP stage, or (count/max being monoids) the device-path stage.
+  max(ts)``) exists in three flavours: the incremental fold
+  ``YSBAggregateINC`` (the KF stage, matching the reference's INC flavour),
+  the NIC ``YSBAggregate`` (the WMR MAP stage), and the device
+  ``device_aggregate`` (the kf-tpu stage — count/max are monoids).
 """
 
 from __future__ import annotations
@@ -31,7 +33,7 @@ import numpy as np
 from ..api import MultiPipe
 from ..core.tuples import Schema, batch_from_columns
 from ..core.windows import WinType
-from ..ops.functions import WindowFunction
+from ..ops.functions import WindowFunction, WindowUpdate
 from ..patterns.basic import Filter, Map, Sink, Source
 from ..patterns.key_farm import KeyFarm
 from ..patterns.win_mapreduce import WinMapReduce
@@ -75,6 +77,25 @@ class YSBAggregate(WindowFunction):
         mask = np.arange(pad)[None, :] < lens[:, None]
         return {"count": lens.astype(np.int64),
                 "lastUpdate": np.where(mask, ts, 0).max(axis=1)}
+
+
+class YSBAggregateINC(WindowUpdate):
+    """The same aggregate as an *incremental* per-chunk fold — the
+    reference's actual flavour (aggregateFunctionINC, yahoo_app.hpp:150-156):
+    O(1) state per open window, no archive.  This is what the kf variant
+    runs; the NIC twin above serves the WMR MAP stage and the device path."""
+
+    result_fields = {"count": np.int64, "lastUpdate": np.int64}
+
+    def update(self, key, gwid, row, acc):
+        acc["count"] += 1
+        acc["lastUpdate"] = max(acc["lastUpdate"], row["ts"])
+
+    def update_many(self, key, gwid, rows, acc):
+        if len(rows):
+            acc["count"] += len(rows)
+            acc["lastUpdate"] = max(int(acc["lastUpdate"]),
+                                    int(rows["ts"].max()))
 
 
 class YSBReduce(WindowFunction):
@@ -158,7 +179,7 @@ class YSBSink:
 
 def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
                    pardegree2: int, win_sec: float = 10.0,
-                   chunk: int = 65536, batches=None, on_result=None):
+                   chunk: int = 262144, batches=None, on_result=None):
     """Assemble the YSB MultiPipe.  `variant`: 'kf' (test_ysb_kf) or 'wmr'
     (test_ysb_wmr).  Pass `batches` to override the timed generator with a
     deterministic list (tests)."""
@@ -184,7 +205,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
     sink = YSBSink(start_wall_us, on_result=on_result)
 
     if variant == "kf":
-        agg = KeyFarm(YSBAggregate(), win_us, win_us, WinType.TB,
+        agg = KeyFarm(YSBAggregateINC(), win_us, win_us, WinType.TB,
                       pardegree=pardegree2, name="ysb_kf")
     elif variant == "kf-tpu":
         # the tracked yahoo_test_tpu config: the window stage evaluates on
@@ -213,7 +234,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
 
 
 def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
-        win_sec=10.0, chunk=65536):
+        win_sec=10.0, chunk=262144):
     """Run the benchmark; returns the reference's four stdout metrics
     (test_ysb_kf.cpp:113-116)."""
     pipe, sink, sent = build_pipeline(variant, duration_sec, pardegree1,
@@ -240,7 +261,7 @@ def main(argv=None):
     ap.add_argument("--variant", choices=["kf", "kf-tpu", "wmr"],
                     default="kf")
     ap.add_argument("--win-sec", type=float, default=10.0)
-    ap.add_argument("--chunk", type=int, default=65536)
+    ap.add_argument("--chunk", type=int, default=262144)
     a = ap.parse_args(argv)
     m = run(a.variant, a.length, a.pardegree1, a.pardegree2, a.win_sec,
             a.chunk)
