@@ -100,8 +100,16 @@ struct KeyState {
 
 struct Launch {
     i64 K = 0, R = 0, B = 0, KP = 0, cap = 0;
-    int wire = 0;   // 0=int8 1=int16 2=int32
+    int wire = 0;   // 0=int8 1=int16 2=int32 3=int64
     int rebase = 0;
+    // regular-descriptor compression: when every key's windows form an
+    // arithmetic sequence (start0 + i*slide, constant len — the steady
+    // state of CB sliding windows), only (count, start0, len) per key
+    // cross the wire and the device expands them with an iota; widx maps
+    // each pending window to its index within its key (host-side gather)
+    int regular = 0;
+    i64 cmax = 0;
+    std::vector<int32_t> rcount, rstart0, rlen, widx;   // K, K, K, B
     std::vector<u8> blk;              // K*R in wire dtype
     std::vector<i64> offs;            // K ring write offsets
     std::vector<int32_t> wrows, wstarts, wlens;   // B window descriptors
@@ -310,6 +318,36 @@ struct Core {
                 (int32_t)(wlo[(size_t)i] - keys[(size_t)rr].ring_base);
             L.wlens[(size_t)i] = (int32_t)wlen[(size_t)i];
             L.hlen[(size_t)i] = wlen[(size_t)i];
+        }
+        // regularity detection (one pass): per key, windows must advance
+        // by `slide` ring positions with one constant length
+        if (B > 0 && kind == CB && !hopping) {
+            L.rcount.assign((size_t)K, 0);
+            L.rstart0.assign((size_t)K, 0);
+            L.rlen.assign((size_t)K, 0);
+            L.widx.resize((size_t)B);
+            std::vector<int32_t> expect((size_t)K, 0);
+            bool ok = true;
+            for (i64 i = 0; i < B; ++i) {
+                const size_t r = (size_t)L.wrows[(size_t)i];
+                if (L.rcount[r] == 0) {
+                    L.rstart0[r] = L.wstarts[(size_t)i];
+                    L.rlen[r] = L.wlens[(size_t)i];
+                    expect[r] = L.wstarts[(size_t)i];
+                }
+                if (L.wstarts[(size_t)i] != expect[r]
+                    || L.wlens[(size_t)i] != L.rlen[r]) {
+                    ok = false;
+                    break;
+                }
+                L.widx[(size_t)i] = L.rcount[r]++;
+                expect[r] += (int32_t)slide;
+            }
+            if (ok) {
+                L.regular = 1;
+                for (i64 r = 0; r < K; ++r)
+                    L.cmax = std::max<i64>(L.cmax, L.rcount[(size_t)r]);
+            }
         }
         L.hkey = std::move(hkey);
         L.hid = std::move(hid);
@@ -614,6 +652,32 @@ int wf_launch_peek(void *h, i64 *K, i64 *R, i64 *B, int *wire, int *rebase,
     return 1;
 }
 
+// regular-descriptor metadata of the front launch (call between peek and
+// take): returns 0 when the front launch is irregular
+int wf_launch_peek_regular(void *h, i64 *cmax) {
+    Core *c = (Core *)h;
+    std::lock_guard<std::mutex> lk(c->qmu);
+    if (c->queue.empty()) return 0;
+    Launch &L = c->queue.front();
+    if (!L.regular) return 0;
+    *cmax = L.cmax;
+    return 1;
+}
+
+// fills the per-key regular descriptors + per-window index map of the
+// front launch (valid only when wf_launch_peek_regular returned 1)
+void wf_launch_take_regular(void *h, int32_t *rcount, int32_t *rstart0,
+                            int32_t *rlen, int32_t *widx) {
+    Core *c = (Core *)h;
+    std::lock_guard<std::mutex> lk(c->qmu);
+    Launch &L = c->queue.front();
+    std::memcpy(rcount, L.rcount.data(), (size_t)L.K * 4);
+    std::memcpy(rstart0, L.rstart0.data(), (size_t)L.K * 4);
+    std::memcpy(rlen, L.rlen.data(), (size_t)L.K * 4);
+    if (L.B)
+        std::memcpy(widx, L.widx.data(), (size_t)L.B * 4);
+}
+
 void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
                     int32_t *wstarts, int32_t *wlens, i64 *hkey, i64 *hid,
                     i64 *hts, i64 *hlen) {
@@ -631,8 +695,10 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
     std::memcpy(offs, L.offs.data(), (size_t)L.K * 8);
     if (L.B) {
         std::memcpy(wrows, L.wrows.data(), (size_t)L.B * 4);
-        std::memcpy(wstarts, L.wstarts.data(), (size_t)L.B * 4);
-        std::memcpy(wlens, L.wlens.data(), (size_t)L.B * 4);
+        // callers on the regular path pass null: the per-window start/len
+        // arrays are replaced by the compressed per-key descriptors
+        if (wstarts) std::memcpy(wstarts, L.wstarts.data(), (size_t)L.B * 4);
+        if (wlens) std::memcpy(wlens, L.wlens.data(), (size_t)L.B * 4);
         std::memcpy(hkey, L.hkey.data(), (size_t)L.B * 8);
         std::memcpy(hid, L.hid.data(), (size_t)L.B * 8);
         std::memcpy(hts, L.hts.data(), (size_t)L.B * 8);

@@ -74,6 +74,10 @@ def load():
         lib.wf_launch_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                        p_i64, p_i32, p_i32, p_i32,
                                        p_i64, p_i64, p_i64, p_i64]
+        lib.wf_launch_peek_regular.restype = ctypes.c_int
+        lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
+        lib.wf_launch_take_regular.argtypes = [ctypes.c_void_p, p_i32,
+                                               p_i32, p_i32, p_i32]
         lib.wf_queue_new.restype = ctypes.c_void_p
         lib.wf_queue_new.argtypes = [i64]
         lib.wf_queue_free.argtypes = [ctypes.c_void_p]

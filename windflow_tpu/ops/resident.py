@@ -49,6 +49,50 @@ _STEP_CACHE = {}
 _REDUCE_OPS = ("sum", "min", "max", "prod")
 
 
+def _pad2(a, rows, cols):
+    out = np.zeros((rows, cols), dtype=a.dtype)
+    out[:a.shape[0], :a.shape[1]] = a
+    return out
+
+
+def _pad1(a, size, dtype=np.int32):
+    out = np.zeros(size, dtype=dtype)
+    out[:len(a)] = a
+    return out
+
+
+def _check_ring_overflow(offs, Rb, cap):
+    """dynamic_update_slice clamps the start, which would silently
+    overwrite live cells near the ring end — the host core's rebase
+    invariant must prevent ever getting here."""
+    if len(offs) and int(offs.max()) + Rb > cap:
+        raise ValueError(
+            f"ring overflow: offset {int(offs.max())} + {Rb} > {cap}")
+
+
+def _make_regular_step(key):
+    """Fused append + regular-window sum: descriptors are expanded on the
+    device from per-key (count, start0, len) scalars via an iota."""
+    (_, _op, cap, R, KP, C, blk_dt, acc_dt, slide) = key
+    acc_dt = np.dtype(acc_dt)
+
+    def step(ring, blk, offs, rcount, rstart0, rlen):
+        blk = blk.astype(acc_dt)
+        ring = jax.vmap(
+            lambda row, b, o: lax.dynamic_update_slice(row, b, (o,))
+        )(ring, blk, offs)
+        cs = jnp.cumsum(ring, axis=1)
+        cs = jnp.pad(cs, ((0, 0), (1, 0)))
+        iota = jnp.arange(C, dtype=jnp.int32)
+        s2 = jnp.clip(rstart0[:, None] + iota[None, :] * slide, 0, cap)
+        e2 = jnp.clip(s2 + rlen[:, None], 0, cap)
+        rows = jnp.arange(KP, dtype=jnp.int32)[:, None]
+        out = cs[rows, e2] - cs[rows, s2]
+        return ring, out
+
+    return jax.jit(step)
+
+
 def _make_step(key):
     """Build + jit the fused append+eval step for one shape bucket."""
     (op, cap, R, B, KP, blk_dt, acc_dt, pad) = key
@@ -156,33 +200,17 @@ class ResidentWindowExecutor:
         B = len(wstarts)
         Rb = _bucket(max(R, 1))
         Bb = _bucket(max(B, 1))
-        if len(offs) and int(offs.max()) + Rb > self.cap:
-            # dynamic_update_slice clamps the start, which would silently
-            # overwrite live cells near the ring end — the host core's
-            # rebase invariant must prevent ever getting here
-            raise ValueError(
-                f"ring overflow: offset {int(offs.max())} + {Rb} > {self.cap}")
+        _check_ring_overflow(offs, Rb, self.cap)
         pad = (_bucket(int(wlens.max()) if B else 1)
                if self.op != "sum" else 0)
-
-        def pad2(a, rows, cols):
-            out = np.zeros((rows, cols), dtype=a.dtype)
-            out[:a.shape[0], :a.shape[1]] = a
-            return out
-
-        def pad1(a, size, dtype=np.int32):
-            out = np.zeros(size, dtype=dtype)
-            out[:len(a)] = a
-            return out
-
         key = (self.op, self.cap, Rb, Bb, self.KP, blk.dtype.str,
                self.acc_dtype.str, pad)
         fn = _STEP_CACHE.get(key)
         if fn is None:
             fn = _STEP_CACHE[key] = _make_step(key)
         args = jax.device_put(
-            (pad2(blk, self.KP, Rb), pad1(offs, self.KP),
-             pad1(wrows, Bb), pad1(wstarts, Bb), pad1(wlens, Bb)),
+            (_pad2(blk, self.KP, Rb), _pad1(offs, self.KP),
+             _pad1(wrows, Bb), _pad1(wstarts, Bb), _pad1(wlens, Bb)),
             self.device)
         self._ring, out = fn(self._ring_arr(), *args)
         getattr(out, "copy_to_host_async", lambda: None)()
@@ -190,11 +218,51 @@ class ResidentWindowExecutor:
         while len(self._inflight) > self.depth:
             self._harvest_one()
 
+    def launch_regular(self, meta, blk: np.ndarray, offs: np.ndarray,
+                       rcount: np.ndarray, rstart0: np.ndarray,
+                       rlen: np.ndarray, slide: int, wrows: np.ndarray,
+                       widx: np.ndarray, cmax: int = 0):
+        """Fused append+eval with *regular* window descriptors: per ring
+        row, windows i in [0, rcount[r]) start at rstart0[r] + i*slide with
+        length rlen[r] — only 3 per-key scalars cross the wire instead of
+        3 arrays of B int32 (sum only; the host maps the (KP, C) result
+        back to pending-window order via (wrows, widx))."""
+        if self.op != "sum":
+            raise ValueError("regular descriptors implemented for sum")
+        K, R = blk.shape
+        if K > self.KP:
+            raise ValueError("rectangle exceeds ring rows; reset() first")
+        Rb = _bucket(max(R, 1))
+        C = _bucket(int(cmax) if cmax else
+                    (int(rcount.max()) if len(rcount) else 1))
+        _check_ring_overflow(offs, Rb, self.cap)
+        key = ("reg", self.op, self.cap, Rb, self.KP, C, blk.dtype.str,
+               self.acc_dtype.str, int(slide))
+        fn = _STEP_CACHE.get(key)
+        if fn is None:
+            fn = _STEP_CACHE[key] = _make_regular_step(key)
+        args = jax.device_put(
+            (_pad2(blk, self.KP, Rb), _pad1(offs, self.KP),
+             _pad1(rcount, self.KP), _pad1(rstart0, self.KP),
+             _pad1(rlen, self.KP)),
+            self.device)
+        self._ring, out = fn(self._ring_arr(), *args)
+        getattr(out, "copy_to_host_async", lambda: None)()
+        self._inflight.append((meta, (np.asarray(wrows), np.asarray(widx)),
+                               out))
+        while len(self._inflight) > self.depth:
+            self._harvest_one()
+
     # -------------------------------------------------------------- harvest
 
     def _harvest_one(self):
-        meta, B, out = self._inflight.popleft()
-        self._ready.append((meta, np.asarray(out)[:B]))
+        meta, sel, out = self._inflight.popleft()
+        arr = np.asarray(out)
+        if isinstance(sel, tuple):   # regular launch: (KP, C) -> flat (B,)
+            arr = arr[sel[0], sel[1]]
+        else:
+            arr = arr[:sel]
+        self._ready.append((meta, arr))
 
     def poll(self):
         """Harvest completed launches without blocking on the rest."""

@@ -276,25 +276,50 @@ class NativeResidentCore:
         blk = np.empty((K, R), dtype=_WIRE_DTYPES[wire.value])
         offs = np.empty(K, dtype=np.int64)
         wrows = np.empty(max(B, 1), dtype=np.int32)
-        wstarts = np.empty(max(B, 1), dtype=np.int32)
-        wlens = np.empty(max(B, 1), dtype=np.int32)
         hkey = np.empty(max(B, 1), dtype=np.int64)
         hid = np.empty(max(B, 1), dtype=np.int64)
         hts = np.empty(max(B, 1), dtype=np.int64)
         hlen = np.empty(max(B, 1), dtype=np.int64)
         p32 = ctypes.POINTER(ctypes.c_int32)
         p64 = ctypes.POINTER(ctypes.c_longlong)
+        ex = self.executors[shard]
+        regular = False
+        cmax = ctypes.c_longlong()
+        if (self.reducer.op == "sum"
+                and lib.wf_launch_peek_regular(handle, ctypes.byref(cmax))):
+            regular = True
+            rcount = np.empty(K, dtype=np.int32)
+            rstart0 = np.empty(K, dtype=np.int32)
+            rlen = np.empty(K, dtype=np.int32)
+            widx = np.empty(max(B, 1), dtype=np.int32)
+            lib.wf_launch_take_regular(
+                handle, rcount.ctypes.data_as(p32),
+                rstart0.ctypes.data_as(p32), rlen.ctypes.data_as(p32),
+                widx.ctypes.data_as(p32))
+        if regular:
+            wstarts = wlens = None   # unread: skip the B*4-byte copies
+            wstarts_p = wlens_p = None
+        else:
+            wstarts = np.empty(max(B, 1), dtype=np.int32)
+            wlens = np.empty(max(B, 1), dtype=np.int32)
+            wstarts_p = wstarts.ctypes.data_as(p32)
+            wlens_p = wlens.ctypes.data_as(p32)
         lib.wf_launch_take(
             handle, blk.ctypes.data_as(ctypes.c_void_p),
             offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
-            wstarts.ctypes.data_as(p32), wlens.ctypes.data_as(p32),
+            wstarts_p, wlens_p,
             hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
             hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64))
-        ex = self.executors[shard]
         if rebase.value:
             ex.reset(max(K, 1), cap.value)
-        ex.launch((hkey[:B], hid[:B], hts[:B], hlen[:B]), blk, offs,
-                  wrows[:B], wstarts[:B], wlens[:B])
+        meta = (hkey[:B], hid[:B], hts[:B], hlen[:B])
+        if regular:
+            # per-key arithmetic descriptors instead of 3x B int32 arrays
+            ex.launch_regular(meta, blk, offs, rcount, rstart0, rlen,
+                              self.spec.slide_len, wrows[:B], widx[:B],
+                              cmax=cmax.value)
+        else:
+            ex.launch(meta, blk, offs, wrows[:B], wstarts[:B], wlens[:B])
         return True
 
     def _harvest(self, harvested) -> np.ndarray:
