@@ -133,4 +133,12 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except Exception as e:  # the driver needs a JSON line even on failure
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": f"sum_test_tpu CRASHED: {type(e).__name__}: {e}",
+            "value": 0, "unit": "tuples/sec", "vs_baseline": 0.0}))
+        sys.exit(1)
