@@ -62,7 +62,8 @@ def assert_equal_results(a, b):
         np.testing.assert_array_equal(a[f], b[f])
 
 
-def test_native_is_default_selection():
+def test_native_is_default_selection(monkeypatch):
+    monkeypatch.delenv("WF_NO_NATIVE", raising=False)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         core = make_core_for(WindowSpec(16, 4, WinType.CB), Reducer("sum"))
