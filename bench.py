@@ -30,7 +30,8 @@ N_KEYS = 64
 N_TUPLES = 16_000_000         # total stream length across keys
 WIN, SLIDE = 256, 64
 BATCH_LEN = 1 << 15           # fired-window flush trigger (row trigger first)
-FLUSH_ROWS = 1 << 20          # rows per fused device dispatch
+FLUSH_ROWS = 1 << 19          # rows per fused device dispatch (finer
+                              # granularity pipelines through wire stalls)
 CHUNK = 1 << 20               # stream batch (rows per engine message)
 
 
