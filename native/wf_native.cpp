@@ -202,7 +202,11 @@ struct Core {
                 if (idx > 0 && p[idx - 1] >= s_abs)
                     out_ts = st.ts[st.start + idx - 1];
             }
-            if (st.marker_pos > NEG_INF && st.marker_pos < e_abs)
+            // marker rows overwrite the result ts of windows they fall
+            // below — CB only: TB keeps the closed form above
+            // (winseq.py:_result_ts returns before the marker clause)
+            if (kind != TB && st.marker_pos > NEG_INF
+                && st.marker_pos < e_abs)
                 out_ts = st.marker_ts;
             // result id incl. PLQ/MAP renumbering (win_seq.hpp:396-405)
             i64 rid;

@@ -1,0 +1,138 @@
+"""Multi-chip wiring of the streaming patterns, on the virtual 8-device CPU
+mesh (conftest): farm workers own one device each (the reference gives each
+GPU worker its own stream/device, win_farm_gpu.hpp:132-168), and the
+mesh-resident executor serves every key group from ONE sharded dispatch
+(ring P(kf, None), ops/resident.py:MeshResidentExecutor)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.parallel.mesh import make_mesh
+from windflow_tpu.patterns.win_seq import WinSeq
+from windflow_tpu.patterns.win_seq_tpu import (KeyFarmTPU, WinFarmTPU,
+                                               WinSeqTPU)
+
+from test_farms import cb_stream_batches, run_windowed, tb_stream_batches
+
+KEYS, N = 8, 120
+WIN, SLIDE = 12, 4
+
+
+def stream(wt):
+    return (cb_stream_batches(KEYS, N) if wt is WinType.CB
+            else tb_stream_batches(KEYS, N))
+
+
+def worker_devices(farm):
+    """Every device owning a ring/executor across the farm's replicas."""
+    devs = set()
+    for r in farm.replicas():
+        core = r.core
+        ex = getattr(core, "executor", None)
+        if ex is not None:
+            devs.add(ex.device)
+        for sub in getattr(core, "executors", []):
+            devs.add(sub.device)
+    return devs
+
+
+@pytest.mark.parametrize("farm_cls", [KeyFarmTPU, WinFarmTPU],
+                         ids=["kf", "wf"])
+def test_farm_workers_spread_over_devices(farm_cls):
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest must provide the virtual 8-device mesh"
+    farm = farm_cls(Reducer("sum"), WIN, SLIDE, WinType.CB, pardegree=8,
+                    batch_len=16)
+    devs = worker_devices(farm)
+    assert len(devs) == 8, (
+        f"pardegree=8 farm placed rings on {len(devs)} devices, want 8")
+
+
+def test_farm_device_list_pins_workers():
+    """An explicit device list spreads over exactly those devices."""
+    pick = jax.devices()[:2]
+    farm = KeyFarmTPU(Reducer("sum"), WIN, SLIDE, WinType.CB, pardegree=4,
+                      batch_len=16, device=pick)
+    assert worker_devices(farm) == set(pick)
+
+
+def test_farm_single_device_pins_all_workers():
+    d = jax.devices()[3]
+    farm = KeyFarmTPU(Reducer("sum"), WIN, SLIDE, WinType.CB, pardegree=4,
+                      batch_len=16, device=d)
+    assert worker_devices(farm) == {d}
+
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB], ids=["cb", "tb"])
+@pytest.mark.parametrize("farm_cls", [KeyFarmTPU, WinFarmTPU],
+                         ids=["kf", "wf"])
+def test_spread_farm_matches_seq(farm_cls, wt):
+    """Differential: an 8-worker farm spread over 8 devices produces the
+    host Win_Seq totals with per-key in-order delivery."""
+    ref = run_windowed(WinSeq(Reducer("sum"), WIN, SLIDE, wt), stream(wt))
+    got = run_windowed(
+        farm_cls(Reducer("sum"), WIN, SLIDE, wt, pardegree=8, batch_len=16),
+        stream(wt))
+    assert got.keys() == ref.keys()
+    for k in ref:
+        assert got[k] == ref[k], f"key {k} mismatch"
+
+
+# ---------------------------------------------------------- mesh-resident
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB], ids=["cb", "tb"])
+@pytest.mark.parametrize("op", ["sum", "max"])
+def test_mesh_resident_matches_seq(wt, op):
+    """One WinSeqTPU, ring sharded P(kf, None) over a 4-device mesh: one
+    dispatch serves all key groups; totals equal the host core's."""
+    mesh = make_mesh(n_kf=4)
+    ref = run_windowed(WinSeq(Reducer(op), WIN, SLIDE, wt), stream(wt))
+    got = run_windowed(
+        WinSeqTPU(Reducer(op), WIN, SLIDE, wt, batch_len=16, mesh=mesh),
+        stream(wt))
+    assert got == ref
+
+
+def test_mesh_resident_uses_all_mesh_devices():
+    """Every mesh device must hold live archive rows — the stride mapping
+    (row r -> shard r % S) balances keys over the shards, not just the
+    NamedSharding's formal block count."""
+    mesh = make_mesh(n_kf=8)
+    core = WinSeqTPU(Reducer("sum"), WIN, SLIDE, WinType.CB, batch_len=16,
+                     mesh=mesh).make_core()
+    outs = [core.process(b) for b in stream(WinType.CB)]
+    outs.append(core.flush())
+    assert sum(len(o) for o in outs) > 0
+    ring = core.executor._ring
+    assert ring is not None
+    shards = list(ring.addressable_shards)
+    devs = {s.device for s in shards}
+    assert len(devs) == 8
+    # 8 keys over 8 shards: each shard owns exactly one live key's rows
+    occupancy = [bool(np.asarray(s.data).any()) for s in shards]
+    assert all(occupancy), f"idle shards: {occupancy}"
+
+
+def test_mesh_resident_rejects_non_monoid():
+    mesh = make_mesh(n_kf=2)
+    with pytest.raises(ValueError, match="resident-path Reducer"):
+        WinSeqTPU(Reducer("count"), WIN, SLIDE, WinType.CB,
+                  mesh=mesh).make_core()
+
+
+def test_mesh_resident_many_keys_rebase():
+    """Key cardinality beyond the initial ring forces rebases across the
+    sharded ring; totals must survive them."""
+    mesh = make_mesh(n_kf=4)
+    keys, n = 37, 60   # not a multiple of the shard count
+    ref = run_windowed(WinSeq(Reducer("sum"), 8, 8, WinType.CB),
+                       cb_stream_batches(keys, n))
+    got = run_windowed(
+        WinSeqTPU(Reducer("sum"), 8, 8, WinType.CB, batch_len=8,
+                  flush_rows=64, mesh=mesh),
+        cb_stream_batches(keys, n))
+    assert got == ref

@@ -93,33 +93,66 @@ def _make_regular_step(key):
     return jax.jit(step)
 
 
+def _append_eval(op, cap, pad, acc_dt, ring, blk, offs, rows, starts, lens):
+    """The shared fused append + window-eval body: vmapped per-row append,
+    then cumsum two-point gather (sum) or masked (B, pad) gather-reduce
+    (min/max/prod) — used by the single-device, regular, and mesh steps."""
+    blk = blk.astype(acc_dt)
+    ring = jax.vmap(
+        lambda row, b, o: lax.dynamic_update_slice(row, b, (o,))
+    )(ring, blk, offs)
+    if op == "sum":
+        cs = jnp.cumsum(ring, axis=1)
+        cs = jnp.pad(cs, ((0, 0), (1, 0)))
+        out = cs[rows, starts + lens] - cs[rows, starts]
+    else:  # min/max/prod: masked gather-reduce over resident rows
+        idx = jnp.minimum(
+            starts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
+            cap - 1)
+        vals = ring[rows[:, None], idx]
+        mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < lens[:, None]
+        ident = jnp.asarray(_identity(op, acc_dt), dtype=acc_dt)
+        red = {"min": jnp.min, "max": jnp.max, "prod": jnp.prod}[op]
+        out = red(jnp.where(mask, vals, ident), axis=1)
+    return ring, out
+
+
 def _make_step(key):
     """Build + jit the fused append+eval step for one shape bucket."""
     (op, cap, R, B, KP, blk_dt, acc_dt, pad) = key
-    blk_dt = np.dtype(blk_dt)
     acc_dt = np.dtype(acc_dt)
 
     def step(ring, blk, offs, wrows, wstarts, wlens):
-        blk = blk.astype(acc_dt)
-        ring = jax.vmap(
-            lambda row, b, o: lax.dynamic_update_slice(row, b, (o,))
-        )(ring, blk, offs)
-        if op == "sum":
-            cs = jnp.cumsum(ring, axis=1)
-            cs = jnp.pad(cs, ((0, 0), (1, 0)))
-            out = cs[wrows, wstarts + wlens] - cs[wrows, wstarts]
-        else:  # min/max/prod: masked gather-reduce over resident rows
-            idx = jnp.minimum(
-                wstarts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
-                cap - 1)
-            vals = ring[wrows[:, None], idx]
-            mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < wlens[:, None]
-            ident = jnp.asarray(_identity(op, acc_dt), dtype=acc_dt)
-            red = {"min": jnp.min, "max": jnp.max, "prod": jnp.prod}[op]
-            out = red(jnp.where(mask, vals, ident), axis=1)
-        return ring, out
+        return _append_eval(op, cap, pad, acc_dt, ring, blk, offs,
+                            wrows, wstarts, wlens)
 
     return jax.jit(step)
+
+
+def _make_mesh_step(key):
+    """Build + jit the sharded fused append+eval step: shard_map over the
+    key-group axis — each device appends to and evaluates windows over its
+    own row block of the ring (key groups are embarrassingly parallel, so
+    the program has no collectives; the sharding just keeps each group's
+    archive in its own chip's HBM)."""
+    (_, op, cap, Rb, Bs, KP, blk_dt, acc_dt, pad, mesh, axis) = key
+    acc_dt = np.dtype(acc_dt)
+    from jax.sharding import PartitionSpec as P
+
+    def local(ring, blk, offs, lrows, lstarts, llens):
+        # per-shard views: ring (rps, cap), blk (rps, Rb), offs (rps,),
+        # descriptors (1, Bs) — local rows/starts/lens of this shard's
+        # windows (host pre-grouped them per shard)
+        ring, out = _append_eval(op, cap, pad, acc_dt, ring, blk, offs,
+                                 lrows[0], lstarts[0], llens[0])
+        return ring, out[None, :]
+
+    mapped = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis),
+                  P(axis, None), P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None), P(axis, None)))
+    return jax.jit(mapped)
 
 
 class ResidentWindowExecutor:
@@ -283,3 +316,107 @@ class ResidentWindowExecutor:
             self._harvest_one()
         ready, self._ready = self._ready, []
         return ready
+
+
+class MeshResidentExecutor(ResidentWindowExecutor):
+    """Resident ring sharded ``P(kf, None)`` over a ``jax.sharding.Mesh``:
+    dense-key ring rows are block-distributed over the mesh's key-group
+    axis, so ONE fused append+eval dispatch serves every key group — each
+    chip holds its groups' archives in its own HBM and evaluates its own
+    windows (no collectives; the kf axis is embarrassingly parallel,
+    parallel/mesh.py).  This is the multi-chip form of the reference's
+    per-worker GPU ownership (win_farm_gpu.hpp:132-168) with the farm
+    collapsed into one SPMD program."""
+
+    def __init__(self, op: str, mesh, axis: str = "kf", depth: int = 8,
+                 acc_dtype=np.int32):
+        if axis not in mesh.shape:
+            raise ValueError(f"mesh has no axis {axis!r}: {mesh.shape}")
+        super().__init__(op, device=mesh.devices.flat[0], depth=depth,
+                         acc_dtype=acc_dtype)
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = int(mesh.shape[axis])
+
+    def _sharding(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(*spec))
+
+    def reset(self, n_keys: int, cap: int):
+        S = self.n_shards
+        rows_per_shard = _bucket(max(-(-max(n_keys, 1) // S), 1))
+        self.KP = S * rows_per_shard
+        self.cap = _bucket(max(cap, 16))
+        self._ring = None
+
+    def _ring_arr(self):
+        if self._ring is None:
+            self._ring = jax.device_put(
+                jnp.zeros((self.KP, self.cap), dtype=self.acc_dtype),
+                self._sharding(self.axis, None))
+        return self._ring
+
+    def launch(self, meta, blk: np.ndarray, offs: np.ndarray,
+               wrows: np.ndarray, wstarts: np.ndarray, wlens: np.ndarray):
+        S = self.n_shards
+        K, R = blk.shape
+        if K > self.KP:
+            raise ValueError("rectangle exceeds ring rows; reset() first")
+        rps = self.KP // S
+        B = len(wstarts)
+        wrows = np.asarray(wrows, dtype=np.int64)
+        # STRIDE dense key rows over shards (row r -> shard r % S, local
+        # slot r // S): the host assigns rows in key-arrival order, so a
+        # block mapping would concentrate all live keys on the low shards
+        # while the padded tail idles — striding balances any K
+        shard = wrows % S
+        local = wrows // S
+        # per-shard slot assignment, preserving original order per shard
+        slots = np.zeros(B, dtype=np.int64)
+        maxc = 0
+        for s in range(S):
+            m = shard == s
+            c = int(m.sum())
+            slots[m] = np.arange(c)
+            maxc = max(maxc, c)
+        Bs = _bucket(max(maxc, 1))
+        lrows = np.zeros((S, Bs), dtype=np.int32)
+        lstarts = np.zeros((S, Bs), dtype=np.int32)
+        llens = np.zeros((S, Bs), dtype=np.int32)
+        if B:
+            lrows[shard, slots] = local.astype(np.int32)
+            lstarts[shard, slots] = wstarts
+            llens[shard, slots] = wlens
+        Rb = _bucket(max(R, 1))
+        _check_ring_overflow(offs, Rb, self.cap)
+        pad = (_bucket(int(wlens.max()) if B else 1)
+               if self.op != "sum" else 0)
+        key = ("mesh", self.op, self.cap, Rb, Bs, self.KP, blk.dtype.str,
+               self.acc_dtype.str, pad, self.mesh, self.axis)
+        fn = _STEP_CACHE.get(key)
+        if fn is None:
+            fn = _STEP_CACHE[key] = _make_mesh_step(key)
+        # scatter the rectangle so dense row r lands at physical ring row
+        # (r % S) * rps + r // S — shard-major, matching the window mapping
+        rows = np.arange(K)
+        prow = (rows % S) * rps + rows // S
+        blkp = np.zeros((self.KP, Rb), dtype=blk.dtype)
+        blkp[prow, :R] = blk
+        offsp = np.zeros(self.KP, dtype=np.int32)
+        offsp[prow] = offs
+        args = (jax.device_put(blkp, self._sharding(self.axis, None)),
+                jax.device_put(offsp, self._sharding(self.axis)),
+                jax.device_put(lrows, self._sharding(self.axis, None)),
+                jax.device_put(lstarts, self._sharding(self.axis, None)),
+                jax.device_put(llens, self._sharding(self.axis, None)))
+        self._ring, out = fn(self._ring_arr(), *args)
+        getattr(out, "copy_to_host_async", lambda: None)()
+        # harvest indexes the (S, Bs) result back to flat window order
+        self._inflight.append((meta, (shard, slots), out))
+        while len(self._inflight) > self.depth:
+            self._harvest_one()
+
+    def launch_regular(self, *a, **kw):
+        raise NotImplementedError(
+            "regular-descriptor compression is a native-core optimization; "
+            "the mesh executor takes explicit descriptors")

@@ -37,12 +37,13 @@ class KeyFarm(_Pattern):
         return StandardEmitter(self.parallelism, self.routing,
                                name=f"{self.name}.emitter")
 
-    def _make_core(self, worker):
-        """Core-factory hook: TPU farms override to build device cores."""
+    def _make_core(self, worker, i=0):
+        """Core-factory hook: TPU farms override to build device cores
+        (worker index `i` drives per-worker device placement)."""
         return worker.make_core()
 
     def _make_replica(self, i):
-        node = WinSeqNode(self._make_core(self._seq_template),
+        node = WinSeqNode(self._make_core(self._seq_template, i),
                           f"{self.name}.{i}")
         node.ctx = RuntimeContext(self.parallelism, i, self.name)
         return node

@@ -50,7 +50,7 @@ class NativeResidentCore:
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
                  depth: int = 8, compute_dtype=None, shards: int = 1,
-                 overlap: bool = True):
+                 overlap: bool = True, worker_index: int = 0):
         from ..native import load
         from ..ops.resident import ResidentWindowExecutor
         self._lib = load()
@@ -72,19 +72,26 @@ class NativeResidentCore:
         self._args = dict(batch_len=batch_len, flush_rows=flush_rows,
                           config=config, role=role, map_indexes=map_indexes,
                           result_ts_slide=result_ts_slide, device=device,
-                          depth=depth, compute_dtype=compute_dtype)
-        from .win_seq_tpu import select_acc_dtype
+                          depth=depth, compute_dtype=compute_dtype,
+                          worker_index=worker_index)
+        from .win_seq_tpu import resolve_worker_device, select_acc_dtype
         acc = select_acc_dtype(reducer, compute_dtype)
         # key-sharded multithreading: shard t owns keys with
         # mix64(key) %% S == t (a hash decorrelated from the farm routing
         # modulus — see wf_native.cpp), each with an independent sub-core,
         # device ring, and launch queue; one GIL-released MT call
-        # processes a chunk on S pool threads
+        # processes a chunk on S pool threads.  Shard rings spread over the
+        # visible chips (worker_index * S + t round-robin) so a sharded
+        # core on a multi-chip host keeps each shard's archive on its own
+        # device, like the farms' per-worker device ownership.
         self.shards = max(int(shards), 1)
         self.executors = [
-            ResidentWindowExecutor(reducer.op, device=device, depth=depth,
-                                   acc_dtype=acc)
-            for _ in range(self.shards)]
+            ResidentWindowExecutor(
+                reducer.op,
+                device=resolve_worker_device(
+                    device, worker_index * self.shards + t),
+                depth=depth, acc_dtype=acc)
+            for t in range(self.shards)]
         self.executor = self.executors[0]
         cfg = self.config
         self._hs = [self._lib.wf_core_new(

@@ -203,12 +203,13 @@ class WinFarm(_Pattern):
             return WFCollectorNode(name=f"{self.name}.collector")
         return Collector(name=f"{self.name}.collector")
 
-    def _make_core(self, worker: WinSeq):
-        """Core-factory hook: TPU farms override to build device cores."""
+    def _make_core(self, worker: WinSeq, i=0):
+        """Core-factory hook: TPU farms override to build device cores
+        (worker index `i` drives per-worker device placement)."""
         return worker.make_core()
 
     def _make_replica(self, i):
-        core = self._make_core(self._workers[i])
+        core = self._make_core(self._workers[i], i)
         if self.n_emitters > 1:
             mode = OrderingMode.ID if self.spec.win_type is WinType.CB else OrderingMode.TS
             node = _OrderedWorkerNode(core, self.n_emitters, mode,

@@ -112,7 +112,8 @@ class _MapStage(_Pattern):
         w = self._workers[i]
         if self._device_opts is not None:
             from .win_seq_tpu import make_device_core
-            core = make_device_core(w, self._device_fn, self._device_opts)
+            core = make_device_core(w, self._device_fn, self._device_opts,
+                                    index=i)
         else:
             core = w.make_core()
         node = WinSeqNode(core, f"{self.name}.{i}")

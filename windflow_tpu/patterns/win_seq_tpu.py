@@ -37,6 +37,24 @@ from .win_mapreduce import WinMapReduce
 from .win_seq import WinSeqNode
 
 
+def resolve_worker_device(device, i: int):
+    """Per-worker device placement — farm worker *i* owns a chip the way
+    each reference GPU worker owns a CUDA stream/device
+    (win_farm_gpu.hpp:132-168, win_seq_gpu.hpp:271-306).
+
+    ``None`` spreads workers round-robin over ``jax.devices()`` (on a
+    single-chip host this degenerates to chip 0, unchanged); a list/tuple
+    spreads over exactly those devices; a single device pins every worker
+    to it."""
+    if isinstance(device, (list, tuple)):
+        return device[i % len(device)]
+    if device is None:
+        import jax
+        devs = jax.devices()
+        return devs[i % len(devs)]
+    return device
+
+
 class JaxWindowFunction:
     """User window function for the device path: a JAX-traceable
     ``fn(keys, gwids, cols, mask) -> column(s)`` over a whole window batch
@@ -251,8 +269,9 @@ class ResidentWinSeqCore(WinSeqCore):
                  flush_rows: int = 1 << 20, config: PatternConfig = None,
                  role: Role = Role.SEQ, map_indexes=(0, 1),
                  result_ts_slide=None, device=None, depth: int = 8,
-                 compute_dtype=None):
-        from ..ops.resident import ResidentWindowExecutor
+                 compute_dtype=None, worker_index: int = 0, mesh=None):
+        from ..ops.resident import (MeshResidentExecutor,
+                                    ResidentWindowExecutor)
         if not isinstance(reducer, Reducer):
             raise TypeError("resident device path needs a builtin Reducer")
         super().__init__(spec, reducer, config=config, role=role,
@@ -262,8 +281,13 @@ class ResidentWinSeqCore(WinSeqCore):
         self.field = reducer.field
         self.out_field = reducer.out_field
         acc = select_acc_dtype(reducer, compute_dtype)
-        self.executor = ResidentWindowExecutor(reducer.op, device=device,
-                                               depth=depth, acc_dtype=acc)
+        if mesh is not None:
+            self.executor = MeshResidentExecutor(reducer.op, mesh,
+                                                 depth=depth, acc_dtype=acc)
+        else:
+            self.executor = ResidentWindowExecutor(
+                reducer.op, device=resolve_worker_device(device, worker_index),
+                depth=depth, acc_dtype=acc)
         self.batch_len = batch_len
         self.flush_rows = flush_rows
         self._rowmap = {}     # key -> dense ring row
@@ -323,7 +347,9 @@ class ResidentWinSeqCore(WinSeqCore):
         rowmap = self._rowmap
         K = len(rowmap)
         # --- decide append vs rebase ---
-        rebase = ex.cap == 0 or ex.KP < _bucket(max(K, 1))
+        # (KP < K, not KP < _bucket(K): the mesh executor's KP is a
+        # multiple of its shard count rather than a power of two)
+        rebase = ex.cap == 0 or ex.KP < max(K, 1)
         if not rebase:
             # the append rectangle is (K, Rb) with one global padded width,
             # so every key needs fill + Rb columns of room
@@ -441,22 +467,26 @@ class ResidentWinSeqCore(WinSeqCore):
 _RESIDENT_OPS = ("sum", "min", "max", "prod")
 
 
-def make_device_core(worker, fn, dev_kw):
+def make_device_core(worker, fn, dev_kw, index=0):
     """Build the device-batched core for a prototype host worker (a WinSeq
-    carrying the farm's per-worker spec/config/role plumbing)."""
+    carrying the farm's per-worker spec/config/role plumbing); ``index`` is
+    the farm worker index driving per-worker device placement."""
     return make_core_for(worker.spec, fn, config=worker.config,
                          role=worker.role, map_indexes=worker.map_indexes,
-                         result_ts_slide=worker.result_ts_slide, **dev_kw)
+                         result_ts_slide=worker.result_ts_slide,
+                         worker_index=index, **dev_kw)
 
 
 def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                   role=Role.SEQ, map_indexes=(0, 1), result_ts_slide=None,
                   device=None, depth=None, use_pallas=False,
                   compute_dtype=None, use_resident=None,
-                  flush_rows=1 << 20, shards=1):
+                  flush_rows=1 << 20, shards=1, worker_index=0, mesh=None):
     """Choose the device core implementation: resident-archive (preferred —
     each row crosses the wire once) when the function is a built-in monoid
-    the resident executor evaluates; segment-restaging otherwise."""
+    the resident executor evaluates; segment-restaging otherwise.  With
+    ``mesh`` the resident ring is sharded ``P('kf', None)`` across the mesh
+    devices (one dispatch serves every key group over ICI)."""
     resident = use_resident
     if resident is None:
         resident = (not use_pallas and isinstance(winfunc, Reducer)
@@ -466,12 +496,30 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                     # segment-restaging path unless the user opts in
                     and not (winfunc.op == "sum"
                              and np.issubdtype(winfunc.dtype, np.floating)))
+    if mesh is not None:
+        if not (isinstance(winfunc, Reducer)
+                and winfunc.op in _RESIDENT_OPS):
+            raise ValueError(
+                "mesh execution needs a resident-path Reducer "
+                f"(one of {_RESIDENT_OPS}); got {winfunc!r}")
+        if not resident:
+            raise ValueError(
+                "mesh execution requires the resident path: drop "
+                "use_pallas, and for float sums opt in explicitly with "
+                "use_resident=True (cumsum rounding differs from the "
+                "host's per-window reduction)")
+        return ResidentWinSeqCore(
+            spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
+            config=config, role=role, map_indexes=map_indexes,
+            result_ts_slide=result_ts_slide,
+            depth=depth if depth is not None else 8,
+            compute_dtype=compute_dtype, mesh=mesh)
     if resident:
         kw = dict(batch_len=batch_len, flush_rows=flush_rows, config=config,
                   role=role, map_indexes=map_indexes,
                   result_ts_slide=result_ts_slide, device=device,
                   depth=depth if depth is not None else 8,
-                  compute_dtype=compute_dtype)
+                  compute_dtype=compute_dtype, worker_index=worker_index)
         from ..native import enabled
         if enabled() is not None:
             from .native_core import NativeResidentCore
@@ -480,17 +528,20 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
     return DeviceWinSeqCore(
         spec, winfunc, batch_len=batch_len, config=config, role=role,
         map_indexes=map_indexes, result_ts_slide=result_ts_slide,
-        device=device, depth=depth if depth is not None else 4,
+        device=resolve_worker_device(device, worker_index),
+        depth=depth if depth is not None else 4,
         use_pallas=use_pallas, compute_dtype=compute_dtype)
 
 
 class _DeviceCoreFactory:
     """Mixin for farm variants whose workers are device-batched: the host
     farm builds its prototype workers, `_make_core` swaps in the device
-    core (set `_raw_fn` and `_dev_kw` before calling the farm ctor)."""
+    core (set `_raw_fn` and `_dev_kw` before calling the farm ctor).
+    Worker *i*'s executor lands on device ``i % n`` (resolve_worker_device)
+    so a pardegree-n farm on an n-chip host owns one chip per worker."""
 
-    def _make_core(self, worker):
-        return make_device_core(worker, self._raw_fn, self._dev_kw)
+    def _make_core(self, worker, i=0):
+        return make_device_core(worker, self._raw_fn, self._dev_kw, index=i)
 
 
 class WinSeqTPU(_Pattern):
@@ -502,7 +553,8 @@ class WinSeqTPU(_Pattern):
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
                  depth=None, use_pallas=False, compute_dtype=None,
-                 use_resident=None, flush_rows=1 << 20, shards=1):
+                 use_resident=None, flush_rows=1 << 20, shards=1,
+                 mesh=None):
         super().__init__(name, parallelism=1)
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self._kw = dict(batch_len=batch_len, config=config, role=role,
@@ -511,7 +563,7 @@ class WinSeqTPU(_Pattern):
                         depth=depth, use_pallas=use_pallas,
                         compute_dtype=compute_dtype,
                         use_resident=use_resident, flush_rows=flush_rows,
-                        shards=shards)
+                        shards=shards, mesh=mesh)
         self.winfunc = winfunc
 
     def make_core(self):
