@@ -70,8 +70,14 @@ def run_once(batches, schema):
     df = Dataflow()
     build_pipeline(df, [
         Source(batches=batches, schema=schema),
+        # shards=1: the bench host exposes ONE cpu core (nproc=1), so the
+        # key-sharded MT pool buys no parallelism and each extra shard
+        # costs a scan pass + smaller launches (sweep 2026-07-30:
+        # 1/2/4 shards -> 20.6/15.0/12.8M best-of tps); multi-core hosts
+        # should raise shards to ~cores
         WinSeqTPU(Reducer("sum"), WIN, SLIDE, WinType.CB,
-                  batch_len=BATCH_LEN, flush_rows=FLUSH_ROWS, depth=24, shards=4),
+                  batch_len=BATCH_LEN, flush_rows=FLUSH_ROWS, depth=24,
+                  shards=1),
         Sink(consume, vectorized=True)])
     t0 = time.perf_counter()
     df.run_and_wait_end()

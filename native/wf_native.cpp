@@ -371,22 +371,27 @@ struct Core {
 
     i64 process(const u8 *base, i64 n, i64 itemsize, i64 o_key, i64 o_id,
                 i64 o_ts, i64 o_marker, i64 o_val,
-                i64 shard_mod = 1, i64 shard_id = 0) {
+                i64 shard_mod = 1, i64 shard_id = 0,
+                const u8 *shard_of = nullptr) {
         const i64 q0 = launches_made;
         // One sequential pass (reads stay prefetch-friendly even with
         // interleaved keys); the per-row divisions of the closed-form
         // firing arithmetic (core/winseq.py) are replaced by two monotone
         // comparisons against cached create/fire position thresholds —
         // divisions only run on the (rare) create/fire events.  With
-        // shard_mod > 1 this core owns only keys with key %% shard_mod ==
-        // shard_id (the multithreaded key-sharded path: each shard scans
-        // the chunk and skips foreign rows — sequential bandwidth beats
-        // a scatter pass).
+        // shard_mod > 1 this core owns only keys with mix64(key) %%
+        // shard_mod == shard_id (the multithreaded key-sharded path);
+        // `shard_of` is the precomputed per-row shard-id byte array from
+        // wf_cores_process_mt — a 1-byte compare per foreign row instead
+        // of a hash + division per row per shard.
+        const u8 sid = (u8)shard_id;
         for (i64 i = 0; i < n; ++i) {
             const u8 *rp = base + i * itemsize;
+            if (shard_of != nullptr && shard_of[i] != sid)
+                continue;
             i64 key, id, tsv, val;
             std::memcpy(&key, rp + o_key, 8);
-            if (shard_mod > 1
+            if (shard_of == nullptr && shard_mod > 1
                 && (i64)(mix64((unsigned long long)key)
                          % (unsigned long long)shard_mod) != shard_id)
                 continue;
@@ -617,19 +622,46 @@ ShardPool *shard_pool() {
 }  // namespace
 
 // Key-sharded multithreaded processing: sub-core t consumes keys with
-// mix64(key) % n_shards == t, all shards scanning the same chunk
-// concurrently on pool threads.  Returns total launches queued.
+// mix64(key) % n_shards == t.  Two pool phases: (A) striped parallel fill
+// of a per-row shard-id byte array (one hash per row TOTAL), then (B)
+// every shard processes the chunk, skipping foreign rows with a 1-byte
+// compare — instead of each of the S shards paying a hash + integer
+// division per row (S*n divisions dominated the r1 profile at 56 ns/row).
+// Returns total launches queued.
 i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
                         i64 itemsize, i64 o_key, i64 o_id, i64 o_ts,
                         i64 o_marker, i64 o_val) {
     if (n_shards == 1)
         return ((Core *)hs[0])->process((const u8 *)base, n, itemsize,
                                         o_key, o_id, o_ts, o_marker, o_val);
+    // shared scratch: both phases must run under one lock so a second
+    // engine thread cannot overwrite the byte array between them (leaked
+    // at exit on purpose, like the pool)
+    static std::mutex *mt_mu = new std::mutex();
+    static std::vector<u8> *shard_of = new std::vector<u8>();
+    std::lock_guard<std::mutex> lk(*mt_mu);
+    if ((i64)shard_of->size() < n) shard_of->resize((size_t)n);
+    u8 *so = shard_of->data();
+    const u8 *b8 = (const u8 *)base;
+    const unsigned long long mod = (unsigned long long)n_shards;
+    const bool pow2 = (mod & (mod - 1)) == 0;
+    const unsigned long long mask = mod - 1;
+    const i64 stripes = n_shards;
+    std::function<void(i64)> assign = [&](i64 t) {
+        const i64 lo = t * n / stripes, hi = (t + 1) * n / stripes;
+        for (i64 i = lo; i < hi; ++i) {
+            i64 key;
+            std::memcpy(&key, b8 + i * itemsize + o_key, 8);
+            const unsigned long long h = mix64((unsigned long long)key);
+            so[i] = (u8)(pow2 ? (h & mask) : (h % mod));
+        }
+    };
+    shard_pool()->run(stripes, assign);
     std::vector<i64> res((size_t)n_shards, 0);
     std::function<void(i64)> fn = [&](i64 t) {
         res[(size_t)t] = ((Core *)hs[t])->process(
             (const u8 *)base, n, itemsize, o_key, o_id, o_ts, o_marker,
-            o_val, n_shards, t);
+            o_val, n_shards, t, so);
     };
     shard_pool()->run(n_shards, fn);
     i64 total = 0;
@@ -682,20 +714,25 @@ void wf_launch_take_regular(void *h, int32_t *rcount, int32_t *rstart0,
         std::memcpy(widx, L.widx.data(), (size_t)L.B * 4);
 }
 
-void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
-                    int32_t *wstarts, int32_t *wlens, i64 *hkey, i64 *hid,
-                    i64 *hts, i64 *hlen) {
-    Core *c = (Core *)h;
-    Launch L;
-    {
-        // move the launch out under the lock; the (potentially multi-MB)
-        // copies below must not stall the producer's flush() push
-        std::lock_guard<std::mutex> lk(c->qmu);
-        L = std::move(c->queue.front());
-        c->queue.pop_front();
-    }
+static void take_common(Launch &L, void *blk, i64 rows_pad,
+                        i64 cols_pad, i64 *offs, int32_t *wrows,
+                        int32_t *wstarts, int32_t *wlens, i64 *hkey,
+                        i64 *hid, i64 *hts, i64 *hlen) {
     const i64 isz = 1LL << L.wire;
-    std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));
+    if (rows_pad <= 0) {
+        std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));
+    } else {
+        // write straight into the caller's (rows_pad, cols_pad) rectangle,
+        // zeroing the padding — saves the ship thread's _pad2 re-copy
+        u8 *dst = (u8 *)blk;
+        const u8 *src = L.blk.data();
+        const i64 rowb = L.R * isz, padb = cols_pad * isz;
+        for (i64 r = 0; r < L.K; ++r) {
+            std::memcpy(dst + r * padb, src + r * rowb, (size_t)rowb);
+            std::memset(dst + r * padb + rowb, 0, (size_t)(padb - rowb));
+        }
+        std::memset(dst + L.K * padb, 0, (size_t)((rows_pad - L.K) * padb));
+    }
     std::memcpy(offs, L.offs.data(), (size_t)L.K * 8);
     if (L.B) {
         std::memcpy(wrows, L.wrows.data(), (size_t)L.B * 4);
@@ -708,6 +745,37 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
         std::memcpy(hts, L.hts.data(), (size_t)L.B * 8);
         std::memcpy(hlen, L.hlen.data(), (size_t)L.B * 8);
     }
+}
+
+static Launch pop_front(Core *c) {
+    // move the launch out under the lock; the (potentially multi-MB)
+    // copies afterwards must not stall the producer's flush() push
+    std::lock_guard<std::mutex> lk(c->qmu);
+    Launch L = std::move(c->queue.front());
+    c->queue.pop_front();
+    return L;
+}
+
+void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
+                    int32_t *wstarts, int32_t *wlens, i64 *hkey, i64 *hid,
+                    i64 *hts, i64 *hlen) {
+    Core *c = (Core *)h;
+    Launch L = pop_front(c);
+    take_common(L, blk, 0, 0, offs, wrows, wstarts, wlens,
+                hkey, hid, hts, hlen);
+}
+
+// wf_launch_take writing blk into a zero-padded (rows_pad, cols_pad)
+// rectangle ready for the device (the ship thread hands it to device_put
+// with no further copy)
+void wf_launch_take_padded(void *h, void *blk, i64 rows_pad, i64 cols_pad,
+                           i64 *offs, int32_t *wrows, int32_t *wstarts,
+                           int32_t *wlens, i64 *hkey, i64 *hid, i64 *hts,
+                           i64 *hlen) {
+    Core *c = (Core *)h;
+    Launch L = pop_front(c);
+    take_common(L, blk, rows_pad, cols_pad, offs, wrows, wstarts, wlens,
+                hkey, hid, hts, hlen);
 }
 
 }  // extern "C"

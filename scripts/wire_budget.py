@@ -1,0 +1,49 @@
+"""Wire-budget breakdown for the headline bench workload (VERDICT r1 #2):
+runs the bench pipeline once with WF_PROFILE=1 and prints where the wall
+time goes — native bookkeeping, launch staging, device_put, dispatch,
+harvest blocking, backpressure — plus bytes/rows shipped.
+
+Usage:  WF_PROFILE=1 python scripts/wire_budget.py [n_million_tuples]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("WF_PROFILE", "1")
+
+import bench
+from windflow_tpu.utils import profile
+
+
+def main():
+    if len(sys.argv) > 1:
+        bench.N_TUPLES = int(float(sys.argv[1]) * 1e6)
+    import jax
+    print("devices:", jax.devices())
+    from windflow_tpu.core.tuples import Schema
+    import numpy as np
+    schema = Schema(value=np.int64)
+    batches = bench.make_stream(schema)
+    # warmup (compiles)
+    bench.run_once(batches, schema)
+    profile.reset()
+    t0 = time.perf_counter()
+    dt, n_out, total = bench.run_once(batches, schema)
+    wall = time.perf_counter() - t0
+    print(f"\n{bench.N_TUPLES/1e6:.0f}M tuples in {dt:.3f}s "
+          f"= {bench.N_TUPLES/dt/1e6:.2f}M tuples/sec "
+          f"({n_out} windows)\n")
+    print(profile.dump())
+    print(f"\nwall (incl. graph teardown): {wall:.3f}s")
+    rep = dict(profile.report())
+    ship = sum(rep.get(k, (0, 0))[0] for k in
+               ("launch_take", "device_put", "dispatch", "harvest_wait"))
+    print(f"ship-thread busy total: {ship:.3f}s "
+          f"({100 * ship / dt:.0f}% of run)")
+
+
+if __name__ == "__main__":
+    main()

@@ -199,3 +199,70 @@ def test_resident_rejects_incremental():
                                   Reducer("sum"))
     with pytest.raises(TypeError):
         core.use_incremental()
+
+
+# ------------------------------------------------------------- multi-stat
+
+from windflow_tpu.core.winseq import WinSeqCore as _HostCore
+from windflow_tpu.ops.functions import MultiReducer
+
+
+def _assert_multi_equal(a, b, fields):
+    assert len(a) == len(b)
+    for f in ("key", "id", "ts") + fields:
+        np.testing.assert_array_equal(a[f], b[f], err_msg=f)
+
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB], ids=["cb", "tb"])
+def test_multi_stat_matches_host(wt):
+    """count + max + sum over one shipped column in ONE fused dispatch
+    must equal the host NIC evaluation of the same MultiReducer."""
+    mk = lambda: MultiReducer(("count", None, "n"),
+                              ("max", "value", "hi"),
+                              ("sum", "value", "total"))
+    spec = WindowSpec(12, 4, wt)
+    stream = (cb_stream(3, 150) if wt is WinType.CB else tb_stream(3, 150))
+    host = run_core(_HostCore(spec, mk()), stream)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mk(), batch_len=16)
+    assert isinstance(core, ResidentWinSeqCore)
+    got = run_core(core, stream)
+    assert len(host) > 0
+    _assert_multi_equal(np.sort(host, order=["key", "id"]),
+                        np.sort(got, order=["key", "id"]),
+                        ("n", "hi", "total"))
+
+
+def test_multi_stat_mesh_matches_host():
+    """The same multi-stat windows over a mesh-sharded ring."""
+    from windflow_tpu.parallel.mesh import make_mesh
+    mk = lambda: MultiReducer(("count", None, "n"),
+                              ("max", "value", "hi"))
+    spec = WindowSpec(8, 8, WinType.CB)
+    stream = cb_stream(7, 120)
+    host = run_core(_HostCore(spec, mk()), stream)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mk(), batch_len=8,
+                             mesh=make_mesh(n_kf=4))
+    got = run_core(core, stream)
+    _assert_multi_equal(np.sort(host, order=["key", "id"]),
+                        np.sort(got, order=["key", "id"]), ("n", "hi"))
+
+
+def test_multi_stat_rejects_count_only():
+    with pytest.raises(ValueError, match="non-count"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            make_core_for(WindowSpec(4, 2, WinType.CB),
+                          MultiReducer(("count", None, "n")))
+
+
+def test_multi_stat_rejects_two_fields():
+    with pytest.raises(ValueError, match="resident"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            make_core_for(WindowSpec(4, 2, WinType.CB),
+                          MultiReducer(("sum", "value", "s"),
+                                       ("max", "ts", "m")))

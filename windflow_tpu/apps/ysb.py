@@ -110,22 +110,17 @@ class YSBReduce(WindowFunction):
 
 
 def device_aggregate():
-    """The YSB aggregate as a device window function (count + MAX(ts) over
-    the staged ts column) — COUNT/MAX are monoids, so the whole KF stage
-    can evaluate on the TPU.  Event timestamps are relative microseconds
-    (event_batches), so the int32 device staging is exact for runs under
-    ~35 minutes."""
-    import jax.numpy as jnp
+    """The YSB aggregate as a multi-stat resident reduction: COUNT(*) +
+    MAX(ts) (yahoo_app.hpp:150-156).  The ts column crosses the wire ONCE
+    into the device-resident ring (ops/resident.py); MAX evaluates in one
+    fused dispatch per flush and COUNT is answered host-side from the
+    window lengths — no per-fire restaging (the r1 kf-tpu regression).
+    Event timestamps are relative microseconds (event_batches), so the
+    int32 accumulate dtype is exact for runs under ~35 minutes."""
+    from ..ops.functions import MultiReducer
 
-    from ..patterns.win_seq_tpu import JaxWindowFunction
-
-    def fn(keys, gwids, cols, mask):
-        return (jnp.sum(mask, axis=1),
-                jnp.max(jnp.where(mask, cols["ts"], 0), axis=1))
-
-    return JaxWindowFunction(fn, fields=("ts",),
-                             result_fields={"count": np.int64,
-                                            "lastUpdate": np.int64})
+    return MultiReducer(("count", None, "count"),
+                        ("max", "ts", "lastUpdate"))
 
 
 def event_batches(duration_sec: float, chunk: int, campaigns,

@@ -74,6 +74,9 @@ def load():
         lib.wf_launch_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                        p_i64, p_i32, p_i32, p_i32,
                                        p_i64, p_i64, p_i64, p_i64]
+        lib.wf_launch_take_padded.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64, i64,
+            p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64]
         lib.wf_launch_peek_regular.restype = ctypes.c_int
         lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
         lib.wf_launch_take_regular.argtypes = [ctypes.c_void_p, p_i32,

@@ -175,6 +175,86 @@ class Reducer(WindowFunction, WindowUpdate):
         return True
 
 
+class MultiReducer(WindowFunction, WindowUpdate):
+    """Several monoid stats over the same windows in one evaluation — e.g.
+    YSB's per-campaign COUNT(*) + MAX(ts) (yahoo_app.hpp:150-156), or
+    count + sum + max of one value column.
+
+    ``stats`` are (op, field, out_field) triples or ready Reducers.  Like
+    :class:`Reducer` it serves as NIC function, INC update, and batched
+    function; the resident device path evaluates every non-count stat over
+    ONE shipped column set in one fused dispatch (count is answered
+    host-side from the window lengths — no device work).
+    """
+
+    def __init__(self, *stats, dtype=np.int64):
+        parts = []
+        for s in stats:
+            if isinstance(s, Reducer):
+                parts.append(s)
+            else:
+                op, field, out_field = s
+                parts.append(Reducer(op, field or "value", out_field,
+                                     dtype=dtype))
+        if not parts:
+            raise ValueError("MultiReducer needs at least one stat")
+        outs = [p.out_field for p in parts]
+        if len(set(outs)) != len(outs):
+            raise ValueError(f"duplicate out_fields: {outs}")
+        self.parts = parts
+        self.result_fields = {}
+        for p in parts:
+            self.result_fields.update(p.result_fields)
+        self.required_fields = tuple(dict.fromkeys(
+            f for p in parts for f in p.required_fields))
+
+    @property
+    def device_parts(self):
+        """Stats needing device evaluation (count is free host-side)."""
+        return [p for p in self.parts if p.op != "count"]
+
+    @property
+    def count_parts(self):
+        return [p for p in self.parts if p.op == "count"]
+
+    def resident_field(self):
+        """The single shipped column when every device stat reads the same
+        field (the resident path's requirement); None otherwise."""
+        fields = {p.field for p in self.device_parts}
+        return fields.pop() if len(fields) == 1 else None
+
+    # --- NIC ---
+    def apply(self, key, gwid, rows):
+        return tuple(v for p in self.parts for v in p.apply(key, gwid, rows))
+
+    def apply_batch(self, keys, gwids, cols, lens):
+        out = {}
+        for p in self.parts:
+            out.update(p.apply_batch(keys, gwids, cols, lens))
+        return out
+
+    # --- INC ---
+    def init(self, key, gwid):
+        acc = np.zeros((), dtype=np.dtype(
+            [(k, v) for k, v in self.result_fields.items()]))
+        for p in self.parts:
+            if p.op != "count":
+                acc[p.out_field] = p._identity()
+        return acc
+
+    def update(self, key, gwid, row, acc):
+        for p in self.parts:
+            p.update(key, gwid, row, acc)
+
+    def update_many(self, key, gwid, rows, acc):
+        for p in self.parts:
+            p.update_many(key, gwid, rows, acc)
+
+    @property
+    def supports_batch(self):
+        return True
+
+
 def as_window_function(f, result_fields=None) -> WindowFunction:
     if isinstance(f, WindowFunction):
         return f

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import profile
 from .device import _bucket
 from .monoid import identity as _identity
 
@@ -93,38 +94,53 @@ def _make_regular_step(key):
     return jax.jit(step)
 
 
-def _append_eval(op, cap, pad, acc_dt, ring, blk, offs, rows, starts, lens):
-    """The shared fused append + window-eval body: vmapped per-row append,
-    then cumsum two-point gather (sum) or masked (B, pad) gather-reduce
-    (min/max/prod) — used by the single-device, regular, and mesh steps."""
+def _ring_append(ring, blk, offs, acc_dt):
+    """Vmapped per-row append: write each key's new-row slice at its ring
+    offset, widening the wire dtype to the accumulate dtype."""
     blk = blk.astype(acc_dt)
-    ring = jax.vmap(
+    return jax.vmap(
         lambda row, b, o: lax.dynamic_update_slice(row, b, (o,))
     )(ring, blk, offs)
+
+
+def _ring_eval(op, cap, pad, acc_dt, ring, rows, starts, lens):
+    """Evaluate one monoid over every described window: cumsum two-point
+    gather (sum) or masked (B, pad) gather-reduce (min/max/prod)."""
     if op == "sum":
         cs = jnp.cumsum(ring, axis=1)
         cs = jnp.pad(cs, ((0, 0), (1, 0)))
-        out = cs[rows, starts + lens] - cs[rows, starts]
-    else:  # min/max/prod: masked gather-reduce over resident rows
-        idx = jnp.minimum(
-            starts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
-            cap - 1)
-        vals = ring[rows[:, None], idx]
-        mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < lens[:, None]
-        ident = jnp.asarray(_identity(op, acc_dt), dtype=acc_dt)
-        red = {"min": jnp.min, "max": jnp.max, "prod": jnp.prod}[op]
-        out = red(jnp.where(mask, vals, ident), axis=1)
-    return ring, out
+        return cs[rows, starts + lens] - cs[rows, starts]
+    idx = jnp.minimum(
+        starts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
+        cap - 1)
+    vals = ring[rows[:, None], idx]
+    mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < lens[:, None]
+    ident = jnp.asarray(_identity(op, acc_dt), dtype=acc_dt)
+    red = {"min": jnp.min, "max": jnp.max, "prod": jnp.prod}[op]
+    return red(jnp.where(mask, vals, ident), axis=1)
+
+
+def _append_eval(ops, cap, pad, acc_dt, ring, blk, offs, rows, starts,
+                 lens):
+    """The shared fused append + window-eval body — one append, then every
+    stat of `ops` evaluated over the same ring (multi-stat: e.g. YSB's
+    sum/max over one shipped column set in one dispatch).  Returns the ring
+    and one output per op."""
+    ring = _ring_append(ring, blk, offs, acc_dt)
+    outs = tuple(_ring_eval(op, cap, pad, acc_dt, ring, rows, starts, lens)
+                 for op in ops)
+    return ring, outs
 
 
 def _make_step(key):
     """Build + jit the fused append+eval step for one shape bucket."""
-    (op, cap, R, B, KP, blk_dt, acc_dt, pad) = key
+    (ops, cap, R, B, KP, blk_dt, acc_dt, pad) = key
     acc_dt = np.dtype(acc_dt)
 
     def step(ring, blk, offs, wrows, wstarts, wlens):
-        return _append_eval(op, cap, pad, acc_dt, ring, blk, offs,
-                            wrows, wstarts, wlens)
+        ring, outs = _append_eval(ops, cap, pad, acc_dt, ring, blk, offs,
+                                  wrows, wstarts, wlens)
+        return ring, (outs[0] if len(outs) == 1 else outs)
 
     return jax.jit(step)
 
@@ -135,7 +151,7 @@ def _make_mesh_step(key):
     own row block of the ring (key groups are embarrassingly parallel, so
     the program has no collectives; the sharding just keeps each group's
     archive in its own chip's HBM)."""
-    (_, op, cap, Rb, Bs, KP, blk_dt, acc_dt, pad, mesh, axis) = key
+    (_, ops, cap, Rb, Bs, KP, blk_dt, acc_dt, pad, mesh, axis) = key
     acc_dt = np.dtype(acc_dt)
     from jax.sharding import PartitionSpec as P
 
@@ -143,9 +159,10 @@ def _make_mesh_step(key):
         # per-shard views: ring (rps, cap), blk (rps, Rb), offs (rps,),
         # descriptors (1, Bs) — local rows/starts/lens of this shard's
         # windows (host pre-grouped them per shard)
-        ring, out = _append_eval(op, cap, pad, acc_dt, ring, blk, offs,
-                                 lrows[0], lstarts[0], llens[0])
-        return ring, out[None, :]
+        ring, outs = _append_eval(ops, cap, pad, acc_dt, ring, blk, offs,
+                                  lrows[0], lstarts[0], llens[0])
+        outs = tuple(o[None, :] for o in outs)
+        return ring, (outs[0] if len(outs) == 1 else outs)
 
     mapped = jax.shard_map(
         local, mesh=mesh,
@@ -166,11 +183,19 @@ class ResidentWindowExecutor:
     answered by the segment-restaging path, ops/device.py).
     """
 
-    def __init__(self, op: str, device=None, depth: int = 8,
+    def __init__(self, op, device=None, depth: int = 8,
                  acc_dtype=np.int32):
-        if op not in _REDUCE_OPS:
-            raise ValueError(f"unsupported resident op {op!r}")
-        self.op = op
+        # `op` is one reduce op or a tuple of them: every op evaluates over
+        # the SAME ring in one fused dispatch (multi-stat windows — the
+        # device side of ops.functions.MultiReducer)
+        self.single = isinstance(op, str)
+        self.ops = (op,) if self.single else tuple(op)
+        for o in self.ops:
+            if o not in _REDUCE_OPS:
+                raise ValueError(f"unsupported resident op {o!r}")
+        if not self.ops:
+            raise ValueError("need at least one resident op")
+        self.op = self.ops[0]
         self.device = device or jax.devices()[0]
         self.depth = depth
         self.acc_dtype = np.dtype(acc_dtype)
@@ -235,18 +260,26 @@ class ResidentWindowExecutor:
         Bb = _bucket(max(B, 1))
         _check_ring_overflow(offs, Rb, self.cap)
         pad = (_bucket(int(wlens.max()) if B else 1)
-               if self.op != "sum" else 0)
-        key = (self.op, self.cap, Rb, Bb, self.KP, blk.dtype.str,
+               if any(o != "sum" for o in self.ops) else 0)
+        key = (self.ops, self.cap, Rb, Bb, self.KP, blk.dtype.str,
                self.acc_dtype.str, pad)
         fn = _STEP_CACHE.get(key)
         if fn is None:
             fn = _STEP_CACHE[key] = _make_step(key)
-        args = jax.device_put(
-            (_pad2(blk, self.KP, Rb), _pad1(offs, self.KP),
-             _pad1(wrows, Bb), _pad1(wstarts, Bb), _pad1(wlens, Bb)),
-            self.device)
-        self._ring, out = fn(self._ring_arr(), *args)
-        getattr(out, "copy_to_host_async", lambda: None)()
+        with profile.span("device_put"):
+            blkp = (blk if blk.shape == (self.KP, Rb)
+                    else _pad2(blk, self.KP, Rb))
+            args = jax.device_put(
+                (blkp, _pad1(offs, self.KP),
+                 _pad1(wrows, Bb), _pad1(wstarts, Bb), _pad1(wlens, Bb)),
+                self.device)
+        profile.add("bytes_shipped", blk.nbytes)
+        profile.add("rows_shipped", blk.size)
+        profile.add("windows", B)
+        with profile.span("dispatch"):
+            self._ring, out = fn(self._ring_arr(), *args)
+            for o in (out if isinstance(out, tuple) else (out,)):
+                getattr(o, "copy_to_host_async", lambda: None)()
         self._inflight.append((meta, B, out))
         while len(self._inflight) > self.depth:
             self._harvest_one()
@@ -260,8 +293,9 @@ class ResidentWindowExecutor:
         length rlen[r] — only 3 per-key scalars cross the wire instead of
         3 arrays of B int32 (sum only; the host maps the (KP, C) result
         back to pending-window order via (wrows, widx))."""
-        if self.op != "sum":
-            raise ValueError("regular descriptors implemented for sum")
+        if not (self.single and self.op == "sum"):
+            raise ValueError("regular descriptors implemented for "
+                             "single-stat sum")
         K, R = blk.shape
         if K > self.KP:
             raise ValueError("rectangle exceeds ring rows; reset() first")
@@ -274,13 +308,20 @@ class ResidentWindowExecutor:
         fn = _STEP_CACHE.get(key)
         if fn is None:
             fn = _STEP_CACHE[key] = _make_regular_step(key)
-        args = jax.device_put(
-            (_pad2(blk, self.KP, Rb), _pad1(offs, self.KP),
-             _pad1(rcount, self.KP), _pad1(rstart0, self.KP),
-             _pad1(rlen, self.KP)),
-            self.device)
-        self._ring, out = fn(self._ring_arr(), *args)
-        getattr(out, "copy_to_host_async", lambda: None)()
+        with profile.span("device_put"):
+            blkp = (blk if blk.shape == (self.KP, Rb)
+                    else _pad2(blk, self.KP, Rb))
+            args = jax.device_put(
+                (blkp, _pad1(offs, self.KP),
+                 _pad1(rcount, self.KP), _pad1(rstart0, self.KP),
+                 _pad1(rlen, self.KP)),
+                self.device)
+        profile.add("bytes_shipped", blk.nbytes)
+        profile.add("rows_shipped", blk.size)
+        profile.add("windows", len(wrows))
+        with profile.span("dispatch"):
+            self._ring, out = fn(self._ring_arr(), *args)
+            getattr(out, "copy_to_host_async", lambda: None)()
         self._inflight.append((meta, (np.asarray(wrows), np.asarray(widx)),
                                out))
         while len(self._inflight) > self.depth:
@@ -290,12 +331,15 @@ class ResidentWindowExecutor:
 
     def _harvest_one(self):
         meta, sel, out = self._inflight.popleft()
-        arr = np.asarray(out)
-        if isinstance(sel, tuple):   # regular launch: (KP, C) -> flat (B,)
-            arr = arr[sel[0], sel[1]]
+        multi = isinstance(out, tuple)
+        with profile.span("harvest_wait"):
+            arrs = ([np.asarray(o) for o in out] if multi
+                    else [np.asarray(out)])
+        if isinstance(sel, tuple):   # regular/mesh: index map -> flat (B,)
+            arrs = [a[sel[0], sel[1]] for a in arrs]
         else:
-            arr = arr[:sel]
-        self._ready.append((meta, arr))
+            arrs = [a[:sel] for a in arrs]
+        self._ready.append((meta, tuple(arrs) if multi else arrs[0]))
 
     def poll(self):
         """Harvest completed launches without blocking on the rest."""
@@ -307,6 +351,8 @@ class ResidentWindowExecutor:
     @staticmethod
     def _is_ready(out) -> bool:
         try:
+            if isinstance(out, tuple):
+                return all(o.is_ready() for o in out)
             return out.is_ready()
         except AttributeError:
             return True
@@ -390,8 +436,8 @@ class MeshResidentExecutor(ResidentWindowExecutor):
         Rb = _bucket(max(R, 1))
         _check_ring_overflow(offs, Rb, self.cap)
         pad = (_bucket(int(wlens.max()) if B else 1)
-               if self.op != "sum" else 0)
-        key = ("mesh", self.op, self.cap, Rb, Bs, self.KP, blk.dtype.str,
+               if any(o != "sum" for o in self.ops) else 0)
+        key = ("mesh", self.ops, self.cap, Rb, Bs, self.KP, blk.dtype.str,
                self.acc_dtype.str, pad, self.mesh, self.axis)
         fn = _STEP_CACHE.get(key)
         if fn is None:
@@ -410,7 +456,8 @@ class MeshResidentExecutor(ResidentWindowExecutor):
                 jax.device_put(lstarts, self._sharding(self.axis, None)),
                 jax.device_put(llens, self._sharding(self.axis, None)))
         self._ring, out = fn(self._ring_arr(), *args)
-        getattr(out, "copy_to_host_async", lambda: None)()
+        for o in (out if isinstance(out, tuple) else (out,)):
+            getattr(o, "copy_to_host_async", lambda: None)()
         # harvest indexes the (S, Bs) result back to flat window order
         self._inflight.append((meta, (shard, slots), out))
         while len(self._inflight) > self.depth:

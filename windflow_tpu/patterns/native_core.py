@@ -22,15 +22,19 @@ import numpy as np
 from ..core.tuples import MARKER_FIELD, Schema
 from ..core.windows import PatternConfig, Role, WindowSpec, WinType
 from ..ops.functions import Reducer
+from ..utils import profile
 
 _ROLE_CODE = {Role.SEQ: 0, Role.PLQ: 1, Role.WLQ: 2, Role.MAP: 3,
               Role.REDUCE: 4}
 _WIRE_DTYPES = (np.int8, np.int16, np.int32, np.int64)
 
 
-def _ship_loop(core_ref, ship_q):
-    """Ship-thread main: resolves the core weakref per token so the thread
-    never pins the core's lifetime (a dead core ends the loop)."""
+def _ship_loop(core_ref, ship_q, shard):
+    """Ship-thread main: one thread per key shard, so the shards'
+    device_put / dispatch / harvest overlap on the wire (a single thread
+    would serialize all shards' transfers — the r1 bottleneck).  Resolves
+    the core weakref per token so the thread never pins the core's
+    lifetime (a dead core ends the loop)."""
     while True:
         tok = ship_q.get()
         if tok is None:
@@ -38,7 +42,7 @@ def _ship_loop(core_ref, ship_q):
         core = core_ref()
         if core is None:
             return
-        core._ship_token(tok)
+        core._ship_token(tok, shard)
         del core
 
 
@@ -84,7 +88,10 @@ class NativeResidentCore:
         # visible chips (worker_index * S + t round-robin) so a sharded
         # core on a multi-chip host keeps each shard's archive on its own
         # device, like the farms' per-worker device ownership.
-        self.shards = max(int(shards), 1)
+        # cap at 256: the C++ MT path routes rows via a per-row shard-id
+        # *byte* array (wf_native.cpp:wf_cores_process_mt), so ids beyond
+        # u8 would alias and double-process rows
+        self.shards = max(min(int(shards), 256), 1)
         self.executors = [
             ResidentWindowExecutor(
                 reducer.op,
@@ -118,21 +125,28 @@ class NativeResidentCore:
         #: provided (each queued Launch holds a staged K*R block)
         self._max_pending = 2 * depth
         if self._overlap:
-            self._ship_q = _queue.SimpleQueue()
             self._out_q = _queue.SimpleQueue()
-            # the thread holds only a weakref: a live ship thread must not
-            # keep the core (and its C++ heap + device rings) alive
-            self._ship_thread = threading.Thread(
-                target=_ship_loop, args=(weakref.ref(self), self._ship_q),
-                daemon=True, name="wf-ship")
-            self._ship_thread.start()
+            # one ship thread per shard: each owns its executor, so the
+            # shards' wire traffic overlaps; threads hold only a weakref
+            # (a live ship thread must not keep the core and its C++ heap
+            # + device rings alive)
+            self._ship_qs = [_queue.SimpleQueue()
+                             for _ in range(self.shards)]
+            self._ship_threads = [
+                threading.Thread(
+                    target=_ship_loop,
+                    args=(weakref.ref(self), self._ship_qs[t], t),
+                    daemon=True, name=f"wf-ship.{t}")
+                for t in range(self.shards)]
+            for th in self._ship_threads:
+                th.start()
 
     def _stop_worker(self):
-        t = getattr(self, "_ship_thread", None)
-        if t is not None and t.is_alive():
-            self._ship_q.put(None)
-            t.join(timeout=10)
-        self._ship_thread = None
+        for t, th in enumerate(getattr(self, "_ship_threads", ()) or ()):
+            if th is not None and th.is_alive():
+                self._ship_qs[t].put(None)
+                th.join(timeout=10)
+        self._ship_threads = []
 
     def __del__(self):
         if getattr(self, "_overlap", False):
@@ -143,16 +157,15 @@ class NativeResidentCore:
 
     # ------------------------------------------------------------ ship thread
 
-    def _ship_token(self, tok):
+    def _ship_token(self, tok, shard):
         kind, ev = tok
         try:
-            for t in range(self.shards):
-                while self._ship_launch(t):
-                    pass
-                got = (self.executors[t].drain() if kind == "drain"
-                       else self.executors[t].poll())
-                for item in got:
-                    self._out_q.put(item)
+            while self._ship_launch(shard):
+                pass
+            got = (self.executors[shard].drain() if kind == "drain"
+                   else self.executors[shard].poll())
+            for item in got:
+                self._out_q.put(item)
         except BaseException as e:  # surfaced on the node thread
             self._ship_exc = e
         finally:
@@ -214,17 +227,20 @@ class NativeResidentCore:
             return self._fall_back().process(batch)
         b = np.ascontiguousarray(batch)
         itemsize, o_key, o_id, o_ts, o_mk, o_val = off
-        self._lib.wf_cores_process_mt(
-            self._harr, self.shards, b.ctypes.data, len(b), itemsize,
-            o_key, o_id, o_ts, o_mk, o_val)
+        with profile.span("native_bookkeeping"):
+            self._lib.wf_cores_process_mt(
+                self._harr, self.shards, b.ctypes.data, len(b), itemsize,
+                o_key, o_id, o_ts, o_mk, o_val)
         if self._overlap:
-            self._ship_q.put(("ship", None))
+            for q in self._ship_qs:
+                q.put(("ship", None))
             # backpressure: if the device path is slower than ingestion,
-            # wait for the ship thread to work the C++ queue down
-            while (self._ship_exc is None
-                   and max(self._lib.wf_launch_pending(h)
-                           for h in self._hs) > self._max_pending):
-                time.sleep(0.001)
+            # wait for the ship threads to work the C++ queues down
+            with profile.span("backpressure_wait"):
+                while (self._ship_exc is None
+                       and max(self._lib.wf_launch_pending(h)
+                               for h in self._hs) > self._max_pending):
+                    time.sleep(0.001)
             drained = self._drain_out_q()
             if self._ship_exc is not None:
                 self._raise_ship_exc(drained)
@@ -243,9 +259,11 @@ class NativeResidentCore:
         for h in self._hs:
             self._lib.wf_core_eos(h)
         if self._overlap:
-            ev = threading.Event()
-            self._ship_q.put(("drain", ev))
-            ev.wait()
+            evs = [threading.Event() for _ in self._ship_qs]
+            for q, ev in zip(self._ship_qs, evs):
+                q.put(("drain", ev))
+            for ev in evs:
+                ev.wait()
             drained = self._drain_out_q()
             if self._ship_exc is not None:
                 self._raise_ship_exc(drained)
@@ -280,7 +298,11 @@ class NativeResidentCore:
                                   ctypes.byref(cap)):
             return False
         K, R, B = K.value, R.value, B.value
-        blk = np.empty((K, R), dtype=_WIRE_DTYPES[wire.value])
+        # allocate the device-ready zero-padded rectangle and let the C++
+        # take fill it directly (no _pad2 re-copy on this thread)
+        from ..ops.device import _bucket
+        KPp, Rb = KP.value, _bucket(max(R, 1))
+        blk = np.empty((KPp, Rb), dtype=_WIRE_DTYPES[wire.value])
         offs = np.empty(K, dtype=np.int64)
         wrows = np.empty(max(B, 1), dtype=np.int32)
         hkey = np.empty(max(B, 1), dtype=np.int64)
@@ -311,12 +333,13 @@ class NativeResidentCore:
             wlens = np.empty(max(B, 1), dtype=np.int32)
             wstarts_p = wstarts.ctypes.data_as(p32)
             wlens_p = wlens.ctypes.data_as(p32)
-        lib.wf_launch_take(
-            handle, blk.ctypes.data_as(ctypes.c_void_p),
-            offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
-            wstarts_p, wlens_p,
-            hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
-            hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64))
+        with profile.span("launch_take"):
+            lib.wf_launch_take_padded(
+                handle, blk.ctypes.data_as(ctypes.c_void_p), KPp, Rb,
+                offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
+                wstarts_p, wlens_p,
+                hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
+                hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64))
         if rebase.value:
             ex.reset(max(K, 1), cap.value)
         meta = (hkey[:B], hid[:B], hts[:B], hlen[:B])

@@ -27,7 +27,7 @@ from ..core.tuples import Schema
 from ..core.windows import PatternConfig, Role, WindowSpec, WinType
 from ..core.winseq import WinSeqCore
 from ..ops.device import DeviceWindowExecutor, builtin_batch_fn
-from ..ops.functions import Reducer
+from ..ops.functions import MultiReducer, Reducer
 from ..runtime.node import RuntimeContext
 from .basic import _Pattern
 from .key_farm import KeyFarm
@@ -71,7 +71,7 @@ class JaxWindowFunction:
 def _host_standin(winfunc):
     """Host-side function object carrying the result schema for the
     core/farm template plumbing (the device path never calls it)."""
-    if isinstance(winfunc, Reducer):
+    if isinstance(winfunc, (Reducer, MultiReducer)):
         return winfunc
     if isinstance(winfunc, JaxWindowFunction):
         r = Reducer("count")
@@ -272,21 +272,48 @@ class ResidentWinSeqCore(WinSeqCore):
                  compute_dtype=None, worker_index: int = 0, mesh=None):
         from ..ops.resident import (MeshResidentExecutor,
                                     ResidentWindowExecutor)
-        if not isinstance(reducer, Reducer):
-            raise TypeError("resident device path needs a builtin Reducer")
+        if isinstance(reducer, MultiReducer):
+            # multi-stat: every non-count stat evaluates over ONE shipped
+            # column in one fused dispatch; counts come free from lens
+            self._device_parts = reducer.device_parts
+            self._count_parts = reducer.count_parts
+            field = reducer.resident_field()
+            if not self._device_parts or field is None:
+                raise ValueError(
+                    "resident MultiReducer needs >=1 non-count stat, all "
+                    "over one field (use Reducer('count') for pure counts)")
+        elif isinstance(reducer, Reducer):
+            self._device_parts = [reducer]
+            self._count_parts = []
+            field = reducer.field
+        else:
+            raise TypeError("resident device path needs a builtin Reducer "
+                            "or MultiReducer")
         super().__init__(spec, reducer, config=config, role=role,
                          map_indexes=map_indexes,
                          result_ts_slide=result_ts_slide)
         self.reducer = reducer
-        self.field = reducer.field
-        self.out_field = reducer.out_field
-        acc = select_acc_dtype(reducer, compute_dtype)
+        self.field = field
+        accs = [select_acc_dtype(p, compute_dtype)
+                for p in self._device_parts]
+        kinds = {d.kind for d in accs}
+        if len(kinds) > 1:
+            # one shared ring, one accumulate dtype: a float ring would
+            # silently round sibling integer sums (float32 spacing > 1
+            # above 2^24) — refuse instead
+            raise ValueError(
+                "multi-stat parts disagree on accumulate kind "
+                f"({sorted(str(a) for a in accs)}): split the stats or "
+                "pass an explicit compute_dtype")
+        acc = max(accs, key=lambda d: d.itemsize)
+        ops = tuple(p.op for p in self._device_parts)
+        op_arg = ops[0] if len(ops) == 1 else ops
         if mesh is not None:
-            self.executor = MeshResidentExecutor(reducer.op, mesh,
+            self.executor = MeshResidentExecutor(op_arg, mesh,
                                                  depth=depth, acc_dtype=acc)
         else:
             self.executor = ResidentWindowExecutor(
-                reducer.op, device=resolve_worker_device(device, worker_index),
+                op_arg, device=resolve_worker_device(device, worker_index),
                 depth=depth, acc_dtype=acc)
         self.batch_len = batch_len
         self.flush_rows = flush_rows
@@ -431,13 +458,17 @@ class ResidentWinSeqCore(WinSeqCore):
     def _build_results(self, harvested):
         outs = []
         for hdr, out in harvested:
+            stat_arrs = out if isinstance(out, tuple) else (out,)
             off = 0
             for key, ids, ts, lens in hdr:
                 n = len(ids)
-                vals = finalize_window_values(self.reducer,
-                                              out[off:off + n], lens)
-                outs.append(self._make_results(key, ids, ts,
-                                               {self.out_field: vals}))
+                payload = {}
+                for p, arr in zip(self._device_parts, stat_arrs):
+                    payload[p.out_field] = finalize_window_values(
+                        p, arr[off:off + n], lens)
+                for p in self._count_parts:
+                    payload[p.out_field] = lens.astype(p.dtype)
+                outs.append(self._make_results(key, ids, ts, payload))
                 off += n
         return outs
 
@@ -467,6 +498,18 @@ class ResidentWinSeqCore(WinSeqCore):
 _RESIDENT_OPS = ("sum", "min", "max", "prod")
 
 
+def _multi_resident_ok(winfunc: MultiReducer, use_pallas: bool) -> bool:
+    """Whether a MultiReducer can run on the resident path: >=1 non-count
+    stat, all over one field, all ops resident-evaluable, no float-sum."""
+    dev = winfunc.device_parts
+    return (not use_pallas and bool(dev)
+            and winfunc.resident_field() is not None
+            and all(p.op in _RESIDENT_OPS for p in dev)
+            and not any(p.op == "sum"
+                        and np.issubdtype(p.dtype, np.floating)
+                        for p in dev))
+
+
 def make_device_core(worker, fn, dev_kw, index=0):
     """Build the device-batched core for a prototype host worker (a WinSeq
     carrying the farm's per-worker spec/config/role plumbing); ``index`` is
@@ -487,6 +530,23 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
     the resident executor evaluates; segment-restaging otherwise.  With
     ``mesh`` the resident ring is sharded ``P('kf', None)`` across the mesh
     devices (one dispatch serves every key group over ICI)."""
+    if isinstance(winfunc, MultiReducer):
+        # multi-stat windows are resident-only (the restaging executor has
+        # no multi-output contract); count-only MultiReducers should be a
+        # plain Reducer("count")
+        if use_resident is False or not _multi_resident_ok(winfunc,
+                                                           use_pallas):
+            raise ValueError(
+                "MultiReducer runs on the resident device path only: "
+                "needs >=1 non-count stat, all over one field, ops in "
+                f"{_RESIDENT_OPS}, no float sum (got {winfunc.parts})")
+        return ResidentWinSeqCore(
+            spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
+            config=config, role=role, map_indexes=map_indexes,
+            result_ts_slide=result_ts_slide, device=device,
+            depth=depth if depth is not None else 8,
+            compute_dtype=compute_dtype, worker_index=worker_index,
+            mesh=mesh)
     resident = use_resident
     if resident is None:
         resident = (not use_pallas and isinstance(winfunc, Reducer)

@@ -1,0 +1,76 @@
+"""Env-gated phase timers for the device ship path (``WF_PROFILE=1``).
+
+The wire — not the chip — is the budget on the tunneled TPU (BASELINE.md),
+so the interesting split is host bookkeeping vs ``device_put`` staging vs
+dispatch vs harvest blocking.  Timers are process-wide and near-free when
+disabled; ``report()`` returns {phase: (seconds, calls)} and ``counters()``
+plain accumulators (bytes shipped, launches, rows).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+
+ENABLED = bool(int(os.environ.get("WF_PROFILE", "0") or "0"))
+
+_acc: dict[str, float] = defaultdict(float)
+_cnt: dict[str, int] = defaultdict(int)
+_val: dict[str, float] = defaultdict(float)
+#: ship threads (one per shard) enter the same spans concurrently; the
+#: read-add-store on the accumulators must not lose updates
+_mu = threading.Lock()
+
+
+class span:
+    """``with span("device_put"): ...`` — accumulates wall time per phase."""
+
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        if ENABLED:
+            self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if ENABLED:
+            dt = time.perf_counter() - self.t0
+            with _mu:
+                _acc[self.name] += dt
+                _cnt[self.name] += 1
+        return False
+
+
+def add(name: str, value: float = 1.0):
+    """Accumulate a plain counter (bytes, rows, launches)."""
+    if ENABLED:
+        with _mu:
+            _val[name] += value
+
+
+def report() -> dict:
+    return {k: (round(_acc[k], 4), _cnt[k]) for k in sorted(_acc)}
+
+
+def counters() -> dict:
+    return {k: _val[k] for k in sorted(_val)}
+
+
+def reset():
+    _acc.clear()
+    _cnt.clear()
+    _val.clear()
+
+
+def dump() -> str:
+    lines = ["phase                      seconds    calls"]
+    for k, (s, c) in report().items():
+        lines.append(f"{k:<25} {s:>9.3f} {c:>8d}")
+    for k, v in counters().items():
+        lines.append(f"{k:<25} {v:>14.0f}")
+    return "\n".join(lines)
