@@ -1,0 +1,201 @@
+"""LEVEL1/LEVEL2 graph optimisation (runtime/farm.py:fuse_two_stage — the
+reference's optimize_PaneFarm / optimize_WinMapReduce, pane_farm.hpp:426-466)
+and the multi-emitter Win_Farm path (win_farm.hpp:147-166): differential
+against Win_Seq plus node-count assertions showing the graph shrinks."""
+
+import numpy as np
+import pytest
+
+from windflow_tpu.api.builders import (LEVEL1, LEVEL2, PaneFarm_Builder,
+                                       WinMapReduce_Builder)
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.patterns.basic import Sink, Source
+from windflow_tpu.patterns.pane_farm import PaneFarm
+from windflow_tpu.patterns.win_farm import WinFarm
+from windflow_tpu.patterns.win_mapreduce import WinMapReduce
+from windflow_tpu.patterns.win_seq import WinSeq
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import add_farm, build_pipeline
+
+from test_farms import (SCHEMA, cb_stream_batches, run_windowed,
+                        tb_stream_batches)
+
+KEYS, N = 3, 140
+WIN, SLIDE = 12, 4
+
+
+def stream(wt):
+    return (cb_stream_batches(KEYS, N) if wt is WinType.CB
+            else tb_stream_batches(KEYS, N))
+
+
+def totals(per_key):
+    return sum(v for rs in per_key.values() for _, _, v in rs)
+
+
+def graph_node_count(pattern, batches):
+    df = Dataflow()
+    build_pipeline(df, [Source(batches=iter(batches), schema=SCHEMA),
+                        pattern, Sink(lambda r: None)])
+    return len(df.nodes)
+
+
+# ------------------------------------------------------------ differential
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB], ids=["cb", "tb"])
+@pytest.mark.parametrize("level", [LEVEL1, LEVEL2])
+@pytest.mark.parametrize("inc", [False, True], ids=["nic", "inc"])
+def test_pane_farm_opt_matches_seq(wt, level, inc):
+    ref = totals(run_windowed(
+        WinSeq(Reducer("sum"), WIN, SLIDE, wt, incremental=inc), stream(wt)))
+    for degs in ((1, 1), (3, 1), (1, 3), (2, 3)):
+        pf = PaneFarm(Reducer("sum"), Reducer("sum"), WIN, SLIDE, wt,
+                      plq_degree=degs[0], wlq_degree=degs[1],
+                      plq_incremental=inc, wlq_incremental=inc,
+                      opt_level=level)
+        got = run_windowed(pf, stream(wt))
+        assert totals(got) == ref, f"degs={degs}"
+
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB], ids=["cb", "tb"])
+@pytest.mark.parametrize("level", [LEVEL1, LEVEL2])
+def test_wmr_opt_matches_seq(wt, level):
+    ref = totals(run_windowed(
+        WinSeq(Reducer("sum"), WIN, SLIDE, wt), stream(wt)))
+    for map_deg, red_deg in ((2, 1), (3, 2)):
+        wmr = WinMapReduce(Reducer("sum"), Reducer("sum"), WIN, SLIDE, wt,
+                           map_degree=map_deg, reduce_degree=red_deg,
+                           opt_level=level)
+        got = run_windowed(wmr, stream(wt))
+        assert totals(got) == ref, f"degs={(map_deg, red_deg)}"
+
+
+def test_opt_results_in_order():
+    """LEVEL2's OrderingCore merge must preserve per-key result order."""
+    pf = PaneFarm(Reducer("sum"), Reducer("sum"), WIN, SLIDE, WinType.CB,
+                  plq_degree=3, wlq_degree=2, opt_level=LEVEL2)
+    got = run_windowed(pf, stream(WinType.CB))
+    for key, rs in got.items():
+        ids = [i for i, _, _ in rs]
+        assert ids == sorted(ids), f"key {key} out of order"
+
+
+# ------------------------------------------------------------- node counts
+
+def test_opt_levels_shrink_graph():
+    def pf(level):
+        return PaneFarm(Reducer("sum"), Reducer("sum"), WIN, SLIDE,
+                        WinType.CB, plq_degree=3, wlq_degree=2,
+                        opt_level=level)
+    n0 = graph_node_count(pf(0), stream(WinType.CB))
+    n1 = graph_node_count(pf(LEVEL1), stream(WinType.CB))
+    n2 = graph_node_count(pf(LEVEL2), stream(WinType.CB))
+    # LEVEL1 fuses plq-collector + wlq-emitter (2 threads -> 1);
+    # LEVEL2 removes the boundary entirely (emitter clones ride the plq
+    # worker threads)
+    assert n1 == n0 - 1
+    assert n2 <= n0 - 2
+
+
+def test_opt_level1_seq_seq_single_thread():
+    def pf(level):
+        return PaneFarm(Reducer("sum"), Reducer("sum"), WIN, SLIDE,
+                        WinType.CB, plq_degree=1, wlq_degree=1,
+                        opt_level=level)
+    n0 = graph_node_count(pf(0), stream(WinType.CB))
+    n1 = graph_node_count(pf(LEVEL1), stream(WinType.CB))
+    assert n1 == n0 - 1  # the two sequential cores share one thread
+
+
+def test_builder_withopt_drives_fusion():
+    pf = (PaneFarm_Builder(Reducer("sum"), Reducer("sum"))
+          .withCBWindow(WIN, SLIDE).withParallelism(2, 2)
+          .withOpt(LEVEL2).build())
+    assert pf.opt_level == LEVEL2
+    wmr = (WinMapReduce_Builder(Reducer("sum"), Reducer("sum"))
+           .withCBWindow(WIN, SLIDE).withParallelism(2, 1)
+           .withOpt(LEVEL1).build())
+    assert wmr.opt_level == LEVEL1
+    ref = totals(run_windowed(
+        WinSeq(Reducer("sum"), WIN, SLIDE, WinType.CB), stream(WinType.CB)))
+    assert totals(run_windowed(pf, stream(WinType.CB))) == ref
+    assert totals(run_windowed(wmr, stream(WinType.CB))) == ref
+
+
+# ------------------------------------------------------- multi-emitter WF
+
+def split_stream(batches, n):
+    """Partition a batch stream row-round-robin into n in-order substreams
+    (the reference's multi-emitter mode feeds one emitter per upstream
+    pipeline, win_farm.hpp:147-166)."""
+    outs = [[] for _ in range(n)]
+    for b in batches:
+        for i in range(n):
+            part = b[i::n]
+            if len(part):
+                outs[i].append(part)
+    return outs
+
+
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB], ids=["cb", "tb"])
+@pytest.mark.parametrize("pardegree", [2, 3])
+def test_multi_emitter_win_farm_matches_seq(wt, pardegree):
+    ref = run_windowed(WinSeq(Reducer("sum"), WIN, SLIDE, wt), stream(wt))
+    parts = split_stream(stream(wt), 2)
+
+    per_key = {}
+
+    def snk(row):
+        if row is not None:
+            per_key.setdefault(int(row["key"]), []).append(
+                (int(row["id"]), int(row["ts"]), int(row["value"])))
+
+    df = Dataflow()
+    sources = []
+    for i in range(2):
+        s = Source(batches=iter(parts[i]), schema=SCHEMA,
+                   name=f"src{i}")._make_replica(0)
+        df.add(s)
+        sources.append(s)
+    wf = WinFarm(Reducer("sum"), WIN, SLIDE, wt, pardegree=pardegree,
+                 n_emitters=2)
+    tails = add_farm(df, wf, sources)
+    snk_node = Sink(snk)._make_replica(0)
+    df.add(snk_node)
+    for t in tails:
+        df.connect(t, snk_node)
+    df.run_and_wait_end()
+
+    assert per_key.keys() == ref.keys()
+    for k in ref:
+        assert per_key[k] == ref[k], f"key {k} mismatch"
+
+
+def test_multi_emitter_wrong_upstream_count_raises():
+    df = Dataflow()
+    s = Source(batches=iter(stream(WinType.CB)),
+               schema=SCHEMA)._make_replica(0)
+    df.add(s)
+    wf = WinFarm(Reducer("sum"), WIN, SLIDE, WinType.CB, pardegree=2,
+                 n_emitters=2)
+    with pytest.raises(ValueError, match="n_emitters"):
+        add_farm(df, wf, [s])
+
+
+def test_opt_level_survives_nesting_clone():
+    """clone_with must propagate opt_level so nested replicas keep the
+    requested fusion (and stay differentially correct)."""
+    from windflow_tpu.patterns.nesting import KeyFarmOf, WinFarmOf
+    ref = totals(run_windowed(
+        WinSeq(Reducer("sum"), WIN, SLIDE, WinType.CB), stream(WinType.CB)))
+    for level in (LEVEL1, LEVEL2):
+        pf = PaneFarm(Reducer("sum"), Reducer("sum"), WIN, SLIDE,
+                      WinType.CB, plq_degree=2, wlq_degree=2,
+                      opt_level=level)
+        clone = pf.clone_with("n", slide_len=SLIDE * 2)
+        assert clone.opt_level == level
+        for nested in (KeyFarmOf(PaneFarm(
+                Reducer("sum"), Reducer("sum"), WIN, SLIDE, WinType.CB,
+                plq_degree=2, wlq_degree=2, opt_level=level), pardegree=2),):
+            assert totals(run_windowed(nested, stream(WinType.CB))) == ref

@@ -14,9 +14,14 @@ Differences forced by the platform, mirroring the pattern layer:
   thread-block size — accepted and ignored here (XLA picks its own tiling);
   ``withScratchpad`` likewise only matters to raw CUDA functors and is
   accepted for source compatibility with a warning;
-* ``withOpt(level)`` is accepted for parity; the engine already fuses
-  pass-through shells automatically (runtime/farm.py) and ``chain()`` on
-  MultiPipe is the explicit fusion path, so levels are advisory here.
+* ``withOpt(level)`` drives real graph surgery on the two-stage patterns
+  (Pane_Farm / Win_MapReduce): LEVEL1 fuses the internal
+  collector/emitter boundary into one thread, LEVEL2 removes it entirely
+  and merges at OrderingCore-fronted stage-2 workers
+  (runtime/farm.py:fuse_two_stage — optimize_PaneFarm,
+  pane_farm.hpp:426-466).  For single-farm patterns the engine already
+  fuses pass-through shells automatically and ``chain()`` on MultiPipe is
+  the explicit fusion path, so the level is advisory there.
 """
 
 from __future__ import annotations
@@ -221,7 +226,14 @@ class _WindowMixin:
         return self
 
     def withOpt(self, level: int):
-        self._opt_level = level  # advisory, see module docstring
+        """Graph-optimization level (opt_level_t, basic.hpp:94).  Two-stage
+        patterns (Pane_Farm / Win_MapReduce) honour it: LEVEL1 fuses the
+        stage boundary into one thread, LEVEL2 removes the internal
+        collector and merges at OrderingCore-fronted stage-2 workers
+        (optimize_PaneFarm, pane_farm.hpp:426-466).  For single-farm
+        patterns the engine's chaining already provides the LEVEL1
+        fusion, so the level is advisory there."""
+        self._opt_level = level
         return self
 
 
@@ -341,6 +353,7 @@ class PaneFarm_Builder(_Builder, _WindowMixin, _TwoStageParMixin):
     def _build_kw(self):
         kw = dict(self._kw)
         kw["plq_degree"], kw["wlq_degree"] = self._deg
+        kw["opt_level"] = getattr(self, "_opt_level", 0)
         return kw
 
 
@@ -371,6 +384,7 @@ class WinMapReduce_Builder(_Builder, _WindowMixin, _TwoStageParMixin):
     def _build_kw(self):
         kw = dict(self._kw)
         kw["map_degree"], kw["reduce_degree"] = self._deg
+        kw["opt_level"] = getattr(self, "_opt_level", 0)
         return kw
 
 

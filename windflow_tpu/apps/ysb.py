@@ -174,7 +174,8 @@ class YSBSink:
 
 def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
                    pardegree2: int, win_sec: float = 10.0,
-                   chunk: int = 262144, batches=None, on_result=None):
+                   chunk: int = 262144, batches=None, on_result=None,
+                   opt_level: int = 0):
     """Assemble the YSB MultiPipe.  `variant`: 'kf' (test_ysb_kf) or 'wmr'
     (test_ysb_wmr).  Pass `batches` to override the timed generator with a
     deterministic list (tests)."""
@@ -212,7 +213,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
     elif variant == "wmr":
         agg = WinMapReduce(YSBAggregate(), YSBReduce(), win_us, win_us,
                            WinType.TB, map_degree=max(pardegree2, 2),
-                           name="ysb_wmr")
+                           name="ysb_wmr", opt_level=opt_level)
     else:
         raise ValueError(f"unknown variant {variant!r}")
 
@@ -228,12 +229,37 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
     return pipe, sink, sent
 
 
+def warmup(variant, pardegree1, pardegree2, win_sec, chunk):
+    """Compile-warm the device path before the timed run: pushes a few
+    synthetic chunks through an identical pipeline so the XLA executables
+    for the step's shape buckets are built and cached process-wide
+    (bench.py warms the same way; first compiles cost tens of seconds
+    over the tunnel and belong to no benchmark)."""
+    campaigns = CampaignGenerator()
+    n = [0]
+
+    def fake_clock():
+        # advances ~0.4 s per chunk so windows open/fire like a real run
+        n[0] += 1
+        return n[0] * 0.4
+
+    batches = list(event_batches(4.0, chunk, campaigns, time_fn=fake_clock))
+    pipe, _, _ = build_pipeline(variant, 0, pardegree1, pardegree2,
+                                win_sec, chunk, batches=batches)
+    pipe.run_and_wait_end()
+
+
 def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
-        win_sec=10.0, chunk=262144):
+        win_sec=10.0, chunk=262144, warm=None, opt_level=0):
     """Run the benchmark; returns the reference's four stdout metrics
     (test_ysb_kf.cpp:113-116)."""
+    if warm is None:
+        warm = variant.endswith("-tpu")
+    if warm:
+        warmup(variant, pardegree1, pardegree2, win_sec, chunk)
     pipe, sink, sent = build_pipeline(variant, duration_sec, pardegree1,
-                                      pardegree2, win_sec, chunk)
+                                      pardegree2, win_sec, chunk,
+                                      opt_level=opt_level)
     t0 = time.perf_counter()
     pipe.run_and_wait_end()
     elapsed = time.perf_counter() - t0
@@ -257,9 +283,17 @@ def main(argv=None):
                     default="kf")
     ap.add_argument("--win-sec", type=float, default=10.0)
     ap.add_argument("--chunk", type=int, default=262144)
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the compile warmup (device variants warm "
+                         "by default; first XLA compiles take tens of "
+                         "seconds over the tunnel)")
+    ap.add_argument("--opt", type=int, default=0, choices=[0, 1, 2],
+                    help="graph optimisation level for the wmr variant "
+                         "(optimize_WinMapReduce; LEVEL2 removes the "
+                         "MAP-collector/REDUCE-emitter boundary)")
     a = ap.parse_args(argv)
     m = run(a.variant, a.length, a.pardegree1, a.pardegree2, a.win_sec,
-            a.chunk)
+            a.chunk, warm=False if a.no_warmup else None, opt_level=a.opt)
     print(f"[Main] Total generated messages are {m['generated']}")
     print(f"[Main] Total received results are {m['results']}")
     print(f"[Main] Latency (usec) {m['avg_latency_us']}")

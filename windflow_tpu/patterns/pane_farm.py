@@ -32,7 +32,7 @@ class PaneFarm:
                  win_type=WinType.CB, plq_degree=1, wlq_degree=1,
                  name="pane_farm", plq_incremental=None, wlq_incremental=None,
                  plq_result_fields=None, wlq_result_fields=None, ordered=True,
-                 config: PatternConfig = None):
+                 config: PatternConfig = None, opt_level: int = 0):
         if win_len <= slide_len:
             raise ValueError(
                 "Pane_Farm requires sliding windows (slide < win), "
@@ -45,7 +45,8 @@ class PaneFarm:
             wlq_degree=wlq_degree, plq_incremental=plq_incremental,
             wlq_incremental=wlq_incremental,
             plq_result_fields=plq_result_fields,
-            wlq_result_fields=wlq_result_fields)
+            wlq_result_fields=wlq_result_fields, opt_level=opt_level)
+        self.opt_level = opt_level
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self.pane_len = self.spec.pane_len()
         self.win_type = win_type
@@ -90,7 +91,13 @@ class PaneFarm:
         return self.wlq.result_schema
 
     def instantiate(self, df, upstreams):
-        from ..runtime.farm import add_farm
+        from ..runtime.farm import add_farm, fuse_two_stage
+        if self.opt_level >= 1:
+            # optimize_PaneFarm (pane_farm.hpp:426-466): LEVEL1 fuses the
+            # stage boundary into one thread, LEVEL2 removes the PLQ
+            # collector and merges at OrderingCore-fronted WLQ workers
+            return fuse_two_stage(df, self.plq, self.wlq, upstreams,
+                                  self.opt_level)
         tails = add_farm(df, self.plq, upstreams)
         return add_farm(df, self.wlq, tails)
 

@@ -135,9 +135,14 @@ class _OrderedWorkerNode(WinSeqNode):
     ff_comb(OrderingNode, Win_Seq) worker used behind multiple emitters
     (win_farm.hpp:157-162)."""
 
-    def __init__(self, core, n_channels, mode, name):
+    def __init__(self, core, n_channels, mode, name, per_key=False):
         super().__init__(core, name)
-        self.ordering = OrderingCore(n_channels, mode)
+        # per_key=True for merges of per-key-renumbered producer streams
+        # (LEVEL2 fusion); plain multi-emitter splits are globally
+        # monotone per channel and keep the liveness-preserving global
+        # watermark (see OrderingCore)
+        self.ordering = OrderingCore(n_channels, mode,
+                                     per_key_watermarks=per_key)
 
     def svc_init(self):
         if self.n_input_channels != self.ordering.n_channels:
@@ -171,6 +176,9 @@ class WinFarm(_Pattern):
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self.ordered = ordered
         self.n_emitters = n_emitters
+        #: LEVEL2 fusion flips this: the fused upstreams emit per-key
+        #: renumbered ids, so the workers' merge needs per-key watermarks
+        self.ordering_per_key = False
         self.config = config or PatternConfig.plain(slide_len)
         self.role = role
         # worker template: private slide, nested PatternConfig
@@ -213,7 +221,8 @@ class WinFarm(_Pattern):
         if self.n_emitters > 1:
             mode = OrderingMode.ID if self.spec.win_type is WinType.CB else OrderingMode.TS
             node = _OrderedWorkerNode(core, self.n_emitters, mode,
-                                      f"{self.name}.{i}")
+                                      f"{self.name}.{i}",
+                                      per_key=self.ordering_per_key)
         else:
             node = WinSeqNode(core, f"{self.name}.{i}")
         node.ctx = RuntimeContext(self.parallelism, i, self.name)

@@ -128,17 +128,20 @@ class WinMapReduce:
                  win_type=WinType.CB, map_degree=2, reduce_degree=1,
                  name="win_mr", map_incremental=None, reduce_incremental=None,
                  map_result_fields=None, reduce_result_fields=None,
-                 ordered=True, config: PatternConfig = None):
+                 ordered=True, config: PatternConfig = None,
+                 opt_level: int = 0):
         if map_degree < 2:
             raise ValueError("Win_MapReduce needs a parallel MAP stage "
                              "(win_mapreduce.hpp:135)")
+        self.opt_level = opt_level
         self._proto = dict(
             map_func=map_func, reduce_func=reduce_func, win_len=win_len,
             slide_len=slide_len, win_type=win_type, map_degree=map_degree,
             reduce_degree=reduce_degree, map_incremental=map_incremental,
             reduce_incremental=reduce_incremental,
             map_result_fields=map_result_fields,
-            reduce_result_fields=reduce_result_fields)
+            reduce_result_fields=reduce_result_fields,
+            opt_level=opt_level)
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self.name = name
         self.config = config or PatternConfig.plain(slide_len)
@@ -175,7 +178,13 @@ class WinMapReduce:
         return self.reduce_stage.result_schema
 
     def instantiate(self, df, upstreams):
-        from ..runtime.farm import add_farm
+        from ..runtime.farm import add_farm, fuse_two_stage
+        if self.opt_level >= 1:
+            # optimize_WinMapReduce (the Pane_Farm optimizer's mirror,
+            # win_mapreduce.hpp): fuse the MAP-collector/REDUCE-emitter
+            # boundary (LEVEL1) or merge at the REDUCE workers (LEVEL2)
+            return fuse_two_stage(df, self.map_stage, self.reduce_stage,
+                                  upstreams, self.opt_level)
         tails = add_farm(df, self.map_stage, upstreams)
         return add_farm(df, self.reduce_stage, tails)
 

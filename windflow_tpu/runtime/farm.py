@@ -30,6 +30,32 @@ def add_farm(df: Dataflow, pattern, upstreams: list[Node],
                 "emitter/collector overrides do not apply to composite "
                 f"patterns ({type(pattern).__name__} wires its own stages)")
         return pattern.instantiate(df, upstreams)
+    n_emitters = getattr(pattern, "n_emitters", 1)
+    if n_emitters > 1 and emitter is DEFAULT:
+        # multi-emitter farm (win_farm.hpp:147-166): one emitter clone per
+        # upstream producer, all-to-all into OrderingCore-fronted workers
+        # that k-way-merge the emitters' interleaved substreams
+        if len(upstreams) != n_emitters:
+            raise ValueError(
+                f"{pattern.name}: n_emitters={n_emitters} needs exactly "
+                f"that many upstream producers, got {len(upstreams)}")
+        replicas = pattern.replicas()
+        for r in replicas:
+            df.add(r)
+        for up in upstreams:
+            em = pattern.emitter()
+            df.add(em)
+            df.connect(up, em)
+            for r in replicas:
+                df.connect(em, r)
+        if collector is DEFAULT:
+            collector = pattern.collector()
+        if collector is not None:
+            df.add(collector)
+            for r in replicas:
+                df.connect(r, collector)
+            return [collector]
+        return replicas
     replicas = pattern.replicas()
     for r in replicas:
         df.add(r)
@@ -69,6 +95,124 @@ def add_farm(df: Dataflow, pattern, upstreams: list[Node],
             df.connect(r, collector)
         return [collector]
     return replicas
+
+
+def _is_passthrough_emitter(em) -> bool:
+    return em is None or type(em).__name__ == "StandardEmitter"
+
+
+def fuse_two_stage(df: Dataflow, stage1, stage2, upstreams: list[Node],
+                   level: int) -> list[Node]:
+    """LEVEL1/LEVEL2 fusion of a two-stage windowed composite — the
+    engine-side port of ``optimize_PaneFarm`` / ``optimize_WinMapReduce``
+    (pane_farm.hpp:426-466, win_mapreduce.hpp's mirror).
+
+    * LEVEL1: both boundary nodes survive but run in ONE thread — the
+      stage-1 collector and stage-2 emitter become a :class:`Comb`
+      (``combine_nodes_in_pipeline``, pane_farm.hpp:435-449).  With both
+      stages at degree 1 the two window cores themselves fuse into one
+      thread.
+    * LEVEL2: the stage-1 collector is REMOVED; a clone of stage 2's
+      emitter is fused onto every stage-1 worker
+      (``combine_farms(plq, wlq_emitter, wlq, OrderingNode)``,
+      pane_farm.hpp:459), and every stage-2 worker is fronted by an
+      OrderingCore that k-way merges the stage-1 workers' substreams
+      (the ff_comb(OrderingNode, worker) of multipipe.hpp:218-224).
+    """
+    from ..runtime.comb import make_comb
+    from ..runtime.node import RuntimeContext
+    from ..runtime.ordering import OrderingMode
+    from ..patterns.win_farm import WinFarm, _OrderedWorkerNode
+    from ..core.windows import WinType
+
+    P = stage1.parallelism
+    W = stage2.parallelism
+
+    if level >= 2:
+        # ---- stage 1 workers, each with a fused stage-2 emitter clone ----
+        s1_workers = stage1.replicas()
+        need_emitter = (W > 1
+                        and not _is_passthrough_emitter(stage2.emitter()))
+        combs = []
+        for w in s1_workers:
+            if not need_emitter:
+                combs.append(w)   # single consumer: no routing needed
+            else:
+                em = stage2.emitter()
+                combs.append(make_comb([w, em], name=f"{w.name}+{em.name}"))
+        for c in combs:
+            df.add(c)
+        s1_em = stage1.emitter()
+        if _is_passthrough_emitter(s1_em) and P == 1:
+            for up in upstreams:
+                df.connect(up, combs[0])
+        else:
+            df.add(s1_em)
+            for up in upstreams:
+                df.connect(up, s1_em)
+            for c in combs:
+                df.connect(s1_em, c)
+        # ---- stage 2 workers fronted by an OrderingCore over P channels ----
+        # per-key watermarks: stage-1 workers emit per-key renumbered ids
+        # (PLQ/MAP role), which are NOT globally monotone per channel
+        if isinstance(stage2, WinFarm):
+            stage2.n_emitters = P   # replicas become _OrderedWorkerNodes
+            stage2.ordering_per_key = True
+            s2_workers = stage2.replicas()
+        else:  # degree-1 sequential stage
+            mode = (OrderingMode.ID
+                    if stage2.spec.win_type is WinType.CB else OrderingMode.TS)
+            node = _OrderedWorkerNode(stage2.make_core(), P, mode,
+                                      f"{stage2.name}.0", per_key=True)
+            node.ctx = RuntimeContext(1, 0, stage2.name)
+            s2_workers = [node]
+        for r in s2_workers:
+            df.add(r)
+        for c in combs:
+            for r in s2_workers:
+                df.connect(c, r)
+        collector = stage2.collector() if hasattr(stage2, "collector") else None
+        if collector is not None and not (
+                type(collector).__name__ == "Collector" and W == 1):
+            df.add(collector)
+            for r in s2_workers:
+                df.connect(r, collector)
+            return [collector]
+        return s2_workers
+
+    # ---- LEVEL1 ----
+    if P == 1 and W == 1:
+        # two sequential cores in one thread (ff_comb of the two Win_Seqs)
+        s1 = stage1.replicas()[0]
+        s2 = stage2.replicas()[0]
+        comb = make_comb([s1, s2], name=f"{s1.name}+{s2.name}")
+        df.add(comb)
+        for up in upstreams:
+            df.connect(up, comb)
+        return [comb]
+    # fuse the boundary: stage-1 collector + stage-2 emitter in one thread
+    s1_coll = stage1.collector()
+    s2_em = stage2.emitter()
+    if s1_coll is None or _is_passthrough_emitter(s2_em):
+        tails = add_farm(df, stage1, upstreams)
+        return add_farm(df, stage2, tails)
+    boundary = make_comb([s1_coll, s2_em],
+                         name=f"{s1_coll.name}+{s2_em.name}")
+    add_farm(df, stage1, upstreams, collector=boundary)
+    # the fused emitter routes per output channel: boundary channel d is
+    # stage-2 worker d (connect order defines emit_to indexing)
+    reps = stage2.replicas()
+    for r in reps:
+        df.add(r)
+        df.connect(boundary, r)
+    collector = stage2.collector()
+    if collector is not None and not (
+            type(collector).__name__ == "Collector" and W == 1):
+        df.add(collector)
+        for r in reps:
+            df.connect(r, collector)
+        return [collector]
+    return reps
 
 
 def build_pipeline(df: Dataflow, patterns: list) -> list[Node]:

@@ -3,8 +3,9 @@ watermarks — the reference ``OrderingNode`` (orderingNode.hpp:49-225).
 
 Semantics reproduced exactly:
 
-* per key, ``maxs[c]`` tracks the greatest position seen from channel ``c``;
-  buffered rows are released once their position is <= min(maxs)
+* per key, ``maxs[c]`` tracks the greatest position seen from channel ``c``
+  for THAT key (Key_Descriptor::maxs, orderingNode.hpp:72); buffered rows
+  are released once their position is <= min(maxs)
   (orderingNode.hpp:151-162);
 * EOS *markers* are set aside (keeping the max-position one per key) and
   re-emitted last at EOS, after the residual buffer flush
@@ -37,13 +38,23 @@ class OrderingMode(enum.Enum):
 
 
 class _KeyBuf:
-    __slots__ = ("chans", "marker_row", "marker_pos", "emit_counter")
+    __slots__ = ("chans", "marker_row", "marker_pos", "emit_counter",
+                 "maxs")
 
-    def __init__(self, n_channels):
+    def __init__(self, n_channels, per_key):
         self.chans = [[] for _ in range(n_channels)]  # lists of row chunks
         self.marker_row = None
         self.marker_pos = _NEG_INF
         self.emit_counter = 0
+        # per-channel greatest position seen FOR THIS KEY — the reference's
+        # Key_Descriptor::maxs (orderingNode.hpp:72, per key, not global:
+        # producers like PLQ/MAP workers emit per-key-monotone ids that are
+        # NOT globally monotone across keys, so a global watermark would
+        # release rows early and downstream cores would drop their
+        # out-of-order siblings).  Allocated only in per-key mode; the
+        # default global-watermark mode never reads it.
+        self.maxs = (np.full(n_channels, _NEG_INF, dtype=np.int64)
+                     if per_key else None)
 
     def has_rows(self):
         return any(self.chans)
@@ -53,32 +64,48 @@ class OrderingCore:
     """Reusable merge engine (also fused in front of farm workers, the
     ff_comb(OrderingNode, worker) analog, win_farm.hpp:157-162).
 
-    Watermarks are per *channel* and global across keys, exactly like the
-    reference's ``maxs[]`` (orderingNode.hpp:151-162): a channel's watermark
-    is the greatest position it has delivered on ANY key, so a key flowing
-    on only one channel still advances (disjoint key ranges per producer
-    are the norm after keyed partitioning).  A channel that reaches EOS is
-    excluded from the min (its watermark jumps to +inf,
-    orderingNode.hpp:182-221) so the merge never stalls on finished
-    producers.  Assumes each channel delivers rows in globally
-    nondecreasing position order — true for every producer the runtime
-    wires (sources are monotone; workers process a monotone stream in
-    arrival order)."""
+    Two watermark granularities, for two classes of producer:
 
-    def __init__(self, n_channels: int, mode: OrderingMode):
+    * ``per_key_watermarks=True`` — the reference's semantics
+      (Key_Descriptor::maxs, orderingNode.hpp:72,151-162): per key,
+      ``maxs[c]`` tracks the greatest position channel ``c`` delivered for
+      THAT key.  Required when channels are only per-key monotone — e.g.
+      PLQ/MAP workers emitting per-key-renumbered ids (the LEVEL2 fused
+      merge), where a global watermark would release rows early and the
+      downstream core would drop their out-of-order siblings.
+    * ``per_key_watermarks=False`` (default) — one watermark per channel,
+      global across keys.  Valid only when each channel's stream is
+      GLOBALLY nondecreasing in position (sources are monotone; union
+      branches, multi-emitter splits of a monotone stream), and required
+      there for liveness: a key flowing on only one channel still advances
+      instead of buffering until EOS.
+
+    A channel that reaches EOS is excluded from the min (its watermark
+    jumps to +inf, orderingNode.hpp:182-221) so the merge never stalls on
+    finished producers."""
+
+    def __init__(self, n_channels: int, mode: OrderingMode,
+                 per_key_watermarks: bool = False):
         self.n_channels = n_channels
         self.mode = mode
+        self.per_key = per_key_watermarks
         self.pos_field = "id" if mode is OrderingMode.ID else "ts"
         self._keys: dict[int, _KeyBuf] = {}
+        #: channels that reached EOS (excluded from every key's min)
+        self._eos = np.zeros(n_channels, dtype=bool)
         self.watermark = np.full(n_channels, _NEG_INF, dtype=np.int64)
         self._released_upto = _NEG_INF
 
     def _buf(self, key):
         b = self._keys.get(key)
         if b is None:
-            b = _KeyBuf(self.n_channels)
+            b = _KeyBuf(self.n_channels, self.per_key)
             self._keys[key] = b
         return b
+
+    def _upto(self, kb: _KeyBuf) -> int:
+        live = kb.maxs[~self._eos]
+        return int(live.min()) if len(live) else 2 ** 62
 
     def _release(self, kb: _KeyBuf, key: int, upto: int) -> np.ndarray | None:
         """Pop every buffered row with pos <= upto, merged in pos order."""
@@ -130,11 +157,22 @@ class OrderingCore:
             kb = self._buf(key)
             rows = batch[grp]
             kb.chans[channel].append(rows)
-            touched.append((key, kb))
+            if self.per_key:
+                # per-key watermark advance (orderingNode.hpp:151-152);
+                # only this key's buffered rows can become releasable
+                kb.maxs[channel] = max(int(kb.maxs[channel]),
+                                       int(rows[self.pos_field][-1]))
+                rel = self._release(kb, key, self._upto(kb))
+                if rel is not None:
+                    out.append(rel)
+            else:
+                touched.append((key, kb))
+        if self.per_key:
+            return out
         wm = self.watermark
         wm[channel] = max(int(wm[channel]),
                           int(batch[self.pos_field].max()))
-        upto = int(wm.min())
+        upto = int(wm[~self._eos].min()) if not self._eos.all() else 2 ** 62
         if upto > self._released_upto:
             # watermark advanced: rows of ANY key may become releasable
             self._released_upto = upto
@@ -163,10 +201,21 @@ class OrderingCore:
     def channel_eos(self, channel: int):
         """Exclude a finished channel from the watermark min and release
         what that unblocks (orderingNode.hpp:182-221)."""
-        self.watermark[channel] = 2 ** 62
-        upto = int(self.watermark.min())
-        self._released_upto = max(self._released_upto, upto)
-        return self._release_all(upto)
+        self._eos[channel] = True
+        if not self.per_key:
+            self.watermark[channel] = 2 ** 62
+            upto = (int(self.watermark[~self._eos].min())
+                    if not self._eos.all() else 2 ** 62)
+            self._released_upto = max(self._released_upto, upto)
+            return self._release_all(upto)
+        out = []
+        for key, kb in self._keys.items():
+            if not kb.has_rows():
+                continue
+            rel = self._release(kb, key, self._upto(kb))
+            if rel is not None:
+                out.append(rel)
+        return out
 
     def flush(self):
         """EOS: release everything, then the per-key marker (renumbered too,
