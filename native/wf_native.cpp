@@ -671,6 +671,16 @@ i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
 
 i64 wf_core_eos(void *h) { return ((Core *)h)->eos(); }
 
+// latency-bounded flushing: ship whatever windows/rows are pending even
+// though neither batch_len nor flush_rows has been reached (the host core
+// calls this when its max-delay timer expires; no-op when nothing pends)
+i64 wf_core_force_flush(void *h) {
+    Core *c = (Core *)h;
+    const i64 q0 = c->launches_made;
+    c->flush();
+    return c->launches_made - q0;
+}
+
 i64 wf_launch_pending(void *h) {
     Core *c = (Core *)h;
     std::lock_guard<std::mutex> lk(c->qmu);

@@ -230,3 +230,32 @@ def test_native_hopping_gaps():
     host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
     nat = make_native(spec, Reducer("sum"), batch_len=16, flush_rows=100)
     assert_equal_results(host, run_core(nat, batches))
+
+
+def test_native_max_delay_flushes_partial_batches():
+    """Native core: max_delay_ms ships pending windows via
+    wf_core_force_flush on the next process() after the deadline."""
+    import time as _time
+    import warnings
+    import numpy as np
+    from windflow_tpu.core.windows import WindowSpec, WinType
+    from windflow_tpu.ops.functions import Reducer
+    from windflow_tpu.patterns.native_core import NativeResidentCore
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = NativeResidentCore(WindowSpec(4, 4, WinType.CB),
+                                  Reducer("sum"), batch_len=1 << 20,
+                                  flush_rows=1 << 20, max_delay_ms=1)
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    b = batch_from_columns(Schema(value=np.int64), key=np.zeros(8),
+                           id=np.arange(8), ts=np.arange(8),
+                           value=np.arange(8))
+    got = core.process(b)
+    _time.sleep(0.01)
+    deadline = _time.monotonic() + 5
+    n = len(got)
+    while n == 0 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+        n += len(core.process(b[:0]))
+    assert n > 0, "native max_delay did not ship the pending windows"
+    core.flush()

@@ -266,3 +266,28 @@ def test_multi_stat_rejects_two_fields():
             make_core_for(WindowSpec(4, 2, WinType.CB),
                           MultiReducer(("sum", "value", "s"),
                                        ("max", "ts", "m")))
+
+
+# ---------------------------------------------------------- latency bound
+
+def test_max_delay_flushes_partial_batches():
+    """With max_delay_ms, pending windows ship on the next process() after
+    the deadline even though neither batch_len nor flush_rows was hit."""
+    import time as _time
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(4, 4, WinType.CB), Reducer("sum"),
+                             batch_len=1 << 20, flush_rows=1 << 20,
+                             max_delay_ms=1)
+    b1 = cb_stream(1, 8, chunk=8)[0]
+    got = core.process(b1)          # windows fire internally, none shipped
+    _time.sleep(0.01)
+    got2 = core.process(cb_stream(1, 8, chunk=8, seed=1)[0])
+    # the delayed flush launched; poll on a later call (or drain) sees it
+    deadline = _time.monotonic() + 5
+    n = len(got) + len(got2)
+    while n == 0 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+        n += len(core.process(np.zeros(0, dtype=b1.dtype)))
+    assert n > 0, "max_delay did not ship the pending windows"
+    core.flush()

@@ -77,9 +77,14 @@ class MultiPipe:
     """Deferred-construction pipeline of patterns.  Instances are also the
     operands of :func:`union_multipipes`."""
 
-    def __init__(self, name: str = "pipe", trace_dir: str = None):
+    def __init__(self, name: str = "pipe", trace_dir: str = None,
+                 capacity: int = 16):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
+        #: per-queue chunk capacity (engine Inbox bound): the
+        #: latency/throughput knob — buffered tuples ~= stages x capacity
+        #: x chunk, so end-to-end latency ~= that over the throughput
+        self.capacity = capacity
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -240,7 +245,8 @@ class MultiPipe:
 
     def _build(self) -> Dataflow:
         if self._df is None:
-            df = Dataflow(self.name, trace_dir=self.trace_dir)
+            df = Dataflow(self.name, capacity=self.capacity,
+                      trace_dir=self.trace_dir)
             self._build_into(df)
             self._df = df
         return self._df
@@ -269,7 +275,8 @@ class MultiPipe:
         stays open for further add()/chain() calls."""
         if self._df is not None:
             return self._df.cardinality()
-        df = Dataflow(self.name, trace_dir=self.trace_dir)
+        df = Dataflow(self.name, capacity=self.capacity,
+                      trace_dir=self.trace_dir)
         self._build_into(df)
         return df.cardinality()
 
@@ -293,6 +300,9 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
             raise ValueError(f"cannot union {p.name!r}: it has no source")
         if p._df is not None:
             raise ValueError(f"cannot union {p.name!r}: already running")
-    merged = MultiPipe(name)
+    # the merged pipe builds ONE Dataflow for the whole graph, so the
+    # tightest operand capacity wins (a per-branch latency tuning must not
+    # be silently widened back to the default)
+    merged = MultiPipe(name, capacity=min(p.capacity for p in pipes))
     merged._branches = list(pipes)
     return merged

@@ -22,7 +22,7 @@ from ..patterns.basic import Filter, FlatMap, Map, Sink, Source
 SCHEMA = Schema(value=np.int64)
 
 
-def run(duration_sec=5.0, chunk=16384, pardegree=1):
+def run(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
     import threading
     sent = [0]
     sent_lock = threading.Lock()
@@ -56,7 +56,9 @@ def run(duration_sec=5.0, chunk=16384, pardegree=1):
         rcv[0] += len(batch)
         lat_sum[0] += float((now_us - batch["ts"]).sum())
 
-    pipe = (MultiPipe("micro")
+    # end-to-end latency ~= stages x capacity x chunk / throughput: the
+    # two knobs below trade latency against batching efficiency
+    pipe = (MultiPipe("micro", capacity=capacity)
             .add_source(Source(gen, SCHEMA, parallelism=pardegree,
                                name="micro_src"))
             .add(Map(lambda b: b.__setitem__("value", b["value"] * 3),
@@ -82,9 +84,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description="micro pipeline benchmark")
     ap.add_argument("-l", "--length", type=float, default=5.0)
     ap.add_argument("-p", "--pardegree", type=int, default=1)
-    ap.add_argument("--chunk", type=int, default=16384)
+    ap.add_argument("--chunk", type=int, default=4096)
+    ap.add_argument("--capacity", type=int, default=2,
+                    help="per-queue chunk capacity (latency knob)")
     a = ap.parse_args(argv)
-    m = run(a.length, a.chunk, a.pardegree)
+    m = run(a.length, a.chunk, a.pardegree, a.capacity)
     for k, v in m.items():
         print(f"[micro] {k}: {v}")
     return 0

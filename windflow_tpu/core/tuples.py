@@ -78,3 +78,19 @@ def schema_of(batch: np.ndarray) -> Schema:
     """Recover a Schema from a structured batch array."""
     skip = set(INFO_FIELDS) | {MARKER_FIELD}
     return Schema(**{n: batch.dtype[n] for n in batch.dtype.names if n not in skip})
+
+
+def group_by_key(keys: np.ndarray):
+    """Stable group-by: returns ``(order, starts, ends)`` where
+    ``order[starts[i]:ends[i]]`` indexes group *i*'s rows in arrival order
+    and ``keys[order[starts[i]]]`` is its key.  The one idiom behind every
+    per-key hot path (emitters, accumulator, ordering, window cores);
+    handles the empty batch (all three arrays empty)."""
+    order = np.argsort(keys, kind="stable")
+    if len(order) == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return order, z, z
+    sk = keys[order]
+    starts = np.concatenate(([0], np.flatnonzero(np.diff(sk)) + 1))
+    ends = np.concatenate((starts[1:], [len(sk)]))
+    return order, starts, ends

@@ -62,6 +62,8 @@ def load():
                                         i64, i64, i64, i64, i64, i64, i64]
         lib.wf_core_eos.restype = i64
         lib.wf_core_eos.argtypes = [ctypes.c_void_p]
+        lib.wf_core_force_flush.restype = i64
+        lib.wf_core_force_flush.argtypes = [ctypes.c_void_p]
         lib.wf_cores_process_mt.restype = i64
         lib.wf_cores_process_mt.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,

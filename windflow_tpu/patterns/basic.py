@@ -312,31 +312,52 @@ class FlatMap(_Pattern):
 # --------------------------------------------------------------- Accumulator
 
 class _AccumulatorNode(Node):
-    def __init__(self, fn, init_value, result_schema, name, rich):
+    def __init__(self, fn, init_value, result_schema, name, rich,
+                 vectorized=False):
         super().__init__(name)
         self.fn = fn
         self.init_value = init_value
         self.result_schema = result_schema
         self.rich = rich
+        self.vectorized = vectorized
         self._keys = {}
 
+    def _acc(self, key: int):
+        acc = self._keys.get(key)
+        if acc is None:
+            acc = np.zeros((), dtype=self.result_schema.dtype())
+            acc["key"] = key
+            for f, v in (self.init_value or {}).items():
+                acc[f] = v
+            self._keys[key] = acc
+        return acc
+
     def svc(self, batch, channel=0):
+        if len(batch) == 0:
+            return
         out = np.zeros(len(batch), dtype=self.result_schema.dtype())
         args = (self.ctx,) if self.rich else ()
-        for i, row in enumerate(batch):
-            key = int(row["key"])
-            acc = self._keys.get(key)
-            if acc is None:
-                acc = np.zeros((), dtype=self.result_schema.dtype())
-                acc["key"] = key
-                for f, v in (self.init_value or {}).items():
-                    acc[f] = v
-                self._keys[key] = acc
-            self.fn(row, acc, *args)
-            out[i] = acc  # emit a copy of the running result
+        # group rows by key once (sorted contiguous slices): one state
+        # lookup per distinct key per chunk instead of per row
+        from ..core.tuples import group_by_key
+        keys = batch["key"]
+        order, starts, ends = group_by_key(keys)
+        sk = keys[order]
+        for s, e in zip(starts, ends):
+            idx = order[s:e]
+            acc = self._acc(int(sk[s]))
+            rows = batch[idx]
+            if self.vectorized:
+                # vectorised fold: fn(rows, acc) -> per-row snapshots of
+                # the result fields (len(rows) records)
+                out[idx] = self.fn(rows, acc, *args)
+            else:
+                for j, row in zip(idx, rows):
+                    self.fn(row, acc, *args)
+                    out[j] = acc  # emit a copy of the running result
         # each snapshot carries the header of the row that triggered it
         # (per-key ts order is preserved for downstream consumers)
-        for f in ("id", "ts"):
+        for f in ("key", "id", "ts"):
             out[f] = batch[f]
         self.emit(out)
 
@@ -348,16 +369,21 @@ class Accumulator(_Pattern):
     accumulator.hpp:50-85)."""
 
     def __init__(self, fn, result_schema: Schema, init_value: dict = None,
-                 parallelism=1, name="accumulator", rich=False, routing=None):
+                 parallelism=1, name="accumulator", rich=False, routing=None,
+                 vectorized=False):
         super().__init__(name, parallelism, routing or default_routing)
         self.fn = fn
         self.result_schema = result_schema
         self.init_value = init_value
         self.rich = rich
+        #: vectorised flavour: fn(rows, acc) folds one key's chunk rows
+        #: into acc and returns len(rows) per-row result snapshots
+        self.vectorized = vectorized
 
     def _make_replica(self, i):
         node = _AccumulatorNode(self.fn, self.init_value, self.result_schema,
-                                f"{self.name}.{i}", self.rich)
+                                f"{self.name}.{i}", self.rich,
+                                vectorized=self.vectorized)
         node.ctx = RuntimeContext(self.parallelism, i, self.name)
         return node
 

@@ -54,7 +54,8 @@ class NativeResidentCore:
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
                  depth: int = 8, compute_dtype=None, shards: int = 1,
-                 overlap: bool = True, worker_index: int = 0):
+                 overlap: bool = True, worker_index: int = 0,
+                 max_delay_ms=None):
         from ..native import load
         from ..ops.resident import ResidentWindowExecutor
         self._lib = load()
@@ -77,7 +78,12 @@ class NativeResidentCore:
                           config=config, role=role, map_indexes=map_indexes,
                           result_ts_slide=result_ts_slide, device=device,
                           depth=depth, compute_dtype=compute_dtype,
-                          worker_index=worker_index)
+                          worker_index=worker_index,
+                          max_delay_ms=max_delay_ms)
+        # latency bound (checked per process() call, chunk cadence)
+        self.max_delay_s = (None if max_delay_ms is None
+                            else max_delay_ms / 1e3)
+        self._last_flush_t = None
         from .win_seq_tpu import resolve_worker_device, select_acc_dtype
         acc = select_acc_dtype(reducer, compute_dtype)
         # key-sharded multithreading: shard t owns keys with
@@ -221,16 +227,34 @@ class NativeResidentCore:
         if self._delegate is not None:
             return self._delegate.process(batch)
         if len(batch) == 0:
-            return np.zeros(0, dtype=self._result_dtype)
-        off = self._field_offsets(batch)
-        if off is None:
-            return self._fall_back().process(batch)
-        b = np.ascontiguousarray(batch)
-        itemsize, o_key, o_id, o_ts, o_mk, o_val = off
-        with profile.span("native_bookkeeping"):
-            self._lib.wf_cores_process_mt(
-                self._harr, self.shards, b.ctypes.data, len(b), itemsize,
-                o_key, o_id, o_ts, o_mk, o_val)
+            # keepalive: an empty chunk still advances the max-delay timer
+            # (and harvests), so a thinning stream meets its latency bound
+            if self.max_delay_s is None:
+                return np.zeros(0, dtype=self._result_dtype)
+            b = None
+        else:
+            off = self._field_offsets(batch)
+            if off is None:
+                return self._fall_back().process(batch)
+            b = np.ascontiguousarray(batch)
+        launched = 0
+        if b is not None:
+            itemsize, o_key, o_id, o_ts, o_mk, o_val = self._offsets
+            with profile.span("native_bookkeeping"):
+                launched = self._lib.wf_cores_process_mt(
+                    self._harr, self.shards, b.ctypes.data, len(b), itemsize,
+                    o_key, o_id, o_ts, o_mk, o_val)
+        if self.max_delay_s is not None:
+            now = time.monotonic()
+            if self._last_flush_t is None or launched:
+                # natural flushes restart the latency clock: a saturated
+                # stream must not fragment launches at max_delay cadence
+                self._last_flush_t = now
+            elif now - self._last_flush_t >= self.max_delay_s:
+                # ship pending windows/rows now (test_micro latency bound)
+                for h in self._hs:
+                    self._lib.wf_core_force_flush(h)
+                self._last_flush_t = now
         if self._overlap:
             for q in self._ship_qs:
                 q.put(("ship", None))

@@ -58,12 +58,24 @@ class WinMapEmitterNode(Node):
         if len(batch) == 0:
             return
         keys = batch["key"]
+        # sort-by-key + segmented arange: O(n log n + K) instead of a
+        # full-batch mask per distinct key (collapses at 1e5 keys)
+        from ..core.tuples import group_by_key
+        order, starts, ends = group_by_key(keys)
+        sk = keys[order]
+        counts = ends - starts
+        base = np.empty(len(starts), dtype=np.int64)
+        nd = self._next_dst
+        for i, s in enumerate(starts):     # O(K) scalar dict ops
+            k = int(sk[s])
+            b = nd.get(k)
+            if b is None:
+                b = k % n
+            base[i] = b
+            nd[k] = (b + int(counts[i])) % n
+        rank = np.arange(len(sk), dtype=np.int64) - np.repeat(starts, counts)
         dest = np.empty(len(batch), dtype=np.int64)
-        for k in np.unique(keys):
-            idx = np.flatnonzero(keys == k)
-            nxt = self._next_dst.get(int(k), int(k) % n)
-            dest[idx] = (nxt + np.arange(len(idx))) % n
-            self._next_dst[int(k)] = (nxt + len(idx)) % n
+        dest[order] = (np.repeat(base, counts) + rank) % n
         for d in range(n):
             sub = batch[dest == d]
             if len(sub):

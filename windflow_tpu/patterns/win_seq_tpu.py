@@ -269,7 +269,8 @@ class ResidentWinSeqCore(WinSeqCore):
                  flush_rows: int = 1 << 20, config: PatternConfig = None,
                  role: Role = Role.SEQ, map_indexes=(0, 1),
                  result_ts_slide=None, device=None, depth: int = 8,
-                 compute_dtype=None, worker_index: int = 0, mesh=None):
+                 compute_dtype=None, worker_index: int = 0, mesh=None,
+                 max_delay_ms=None):
         from ..ops.resident import (MeshResidentExecutor,
                                     ResidentWindowExecutor)
         if isinstance(reducer, MultiReducer):
@@ -317,6 +318,12 @@ class ResidentWinSeqCore(WinSeqCore):
                 depth=depth, acc_dtype=acc)
         self.batch_len = batch_len
         self.flush_rows = flush_rows
+        # latency bound: ship pending windows/rows after this many ms even
+        # when neither batch_len nor flush_rows is reached (checked per
+        # process() call — the trigger cadence is the chunk cadence)
+        self.max_delay_s = (None if max_delay_ms is None
+                            else max_delay_ms / 1e3)
+        self._last_flush_t = None
         self._rowmap = {}     # key -> dense ring row
         self._appended = {}   # key -> rows ever archived (abs row domain)
         self._launched = {}   # key -> rows already shipped to the ring
@@ -452,6 +459,12 @@ class ResidentWinSeqCore(WinSeqCore):
         self._pend_rows = 0
         self._wdesc, self._hdr, self._n_wins = [], [], 0
         self._purge_pos = {}
+        if self.max_delay_s is not None:
+            # every flush (natural or forced) restarts the latency clock —
+            # otherwise a saturated stream would fragment launches at
+            # max_delay cadence despite fresh batch_len/flush_rows flushes
+            import time as _time
+            self._last_flush_t = _time.monotonic()
 
     # ---------------------------------------------------------------- harvest
 
@@ -474,6 +487,14 @@ class ResidentWinSeqCore(WinSeqCore):
 
     def process(self, batch):
         super().process(batch)  # fired windows are enqueued, not returned
+        if self.max_delay_s is not None and (self._wdesc or self._pend_rows):
+            import time as _time
+            now = _time.monotonic()
+            if self._last_flush_t is None:
+                self._last_flush_t = now
+            elif now - self._last_flush_t >= self.max_delay_s:
+                self._flush_batch()
+                self._last_flush_t = now
         outs = self._build_results(self.executor.poll())
         if not outs:
             return np.zeros(0, dtype=self._result_dtype)
@@ -524,7 +545,8 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                   role=Role.SEQ, map_indexes=(0, 1), result_ts_slide=None,
                   device=None, depth=None, use_pallas=False,
                   compute_dtype=None, use_resident=None,
-                  flush_rows=1 << 20, shards=1, worker_index=0, mesh=None):
+                  flush_rows=1 << 20, shards=1, worker_index=0, mesh=None,
+                  max_delay_ms=None):
     """Choose the device core implementation: resident-archive (preferred —
     each row crosses the wire once) when the function is a built-in monoid
     the resident executor evaluates; segment-restaging otherwise.  With
@@ -546,7 +568,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
             result_ts_slide=result_ts_slide, device=device,
             depth=depth if depth is not None else 8,
             compute_dtype=compute_dtype, worker_index=worker_index,
-            mesh=mesh)
+            mesh=mesh, max_delay_ms=max_delay_ms)
     resident = use_resident
     if resident is None:
         resident = (not use_pallas and isinstance(winfunc, Reducer)
@@ -573,13 +595,15 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
             config=config, role=role, map_indexes=map_indexes,
             result_ts_slide=result_ts_slide,
             depth=depth if depth is not None else 8,
-            compute_dtype=compute_dtype, mesh=mesh)
+            compute_dtype=compute_dtype, mesh=mesh,
+            max_delay_ms=max_delay_ms)
     if resident:
         kw = dict(batch_len=batch_len, flush_rows=flush_rows, config=config,
                   role=role, map_indexes=map_indexes,
                   result_ts_slide=result_ts_slide, device=device,
                   depth=depth if depth is not None else 8,
-                  compute_dtype=compute_dtype, worker_index=worker_index)
+                  compute_dtype=compute_dtype, worker_index=worker_index,
+                  max_delay_ms=max_delay_ms)
         from ..native import enabled
         if enabled() is not None:
             from .native_core import NativeResidentCore
@@ -614,7 +638,7 @@ class WinSeqTPU(_Pattern):
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
                  depth=None, use_pallas=False, compute_dtype=None,
                  use_resident=None, flush_rows=1 << 20, shards=1,
-                 mesh=None):
+                 mesh=None, max_delay_ms=None):
         super().__init__(name, parallelism=1)
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self._kw = dict(batch_len=batch_len, config=config, role=role,
@@ -623,7 +647,8 @@ class WinSeqTPU(_Pattern):
                         depth=depth, use_pallas=use_pallas,
                         compute_dtype=compute_dtype,
                         use_resident=use_resident, flush_rows=flush_rows,
-                        shards=shards, mesh=mesh)
+                        shards=shards, mesh=mesh,
+                        max_delay_ms=max_delay_ms)
         self.winfunc = winfunc
 
     def make_core(self):
@@ -651,12 +676,14 @@ class WinFarmTPU(_DeviceCoreFactory, WinFarm):
                  pardegree=2, batch_len=512, name="win_farm_tpu",
                  ordered=True, n_emitters=1, config=None, role=Role.SEQ,
                  device=None, depth=None, use_pallas=False,
-                 compute_dtype=None, use_resident=None, flush_rows=1 << 20):
+                 compute_dtype=None, use_resident=None, flush_rows=1 << 20,
+                 max_delay_ms=None):
         self._raw_fn = winfunc
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
                             compute_dtype=compute_dtype,
-                            use_resident=use_resident, flush_rows=flush_rows)
+                            use_resident=use_resident, flush_rows=flush_rows,
+                            max_delay_ms=max_delay_ms)
         super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
                          pardegree=pardegree, name=name, ordered=ordered,
                          n_emitters=n_emitters, config=config, role=role)
@@ -671,12 +698,13 @@ class KeyFarmTPU(_DeviceCoreFactory, KeyFarm):
                  pardegree=2, batch_len=512, name="key_farm_tpu",
                  routing=None, config=None, role=Role.SEQ, device=None,
                  depth=None, use_pallas=False, compute_dtype=None,
-                 use_resident=None, flush_rows=1 << 20):
+                 use_resident=None, flush_rows=1 << 20, max_delay_ms=None):
         self._raw_fn = winfunc
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
                             compute_dtype=compute_dtype,
-                            use_resident=use_resident, flush_rows=flush_rows)
+                            use_resident=use_resident, flush_rows=flush_rows,
+                            max_delay_ms=max_delay_ms)
         super().__init__(_host_standin(winfunc), win_len, slide_len, win_type,
                          pardegree=pardegree, name=name, routing=routing,
                          config=config, role=role)

@@ -49,16 +49,16 @@ class KeyedStreamState:
         # fast path: per-key nondecreasing (the overwhelmingly common case
         # for in-order streams) — one grouped monotonicity check, no
         # per-key Python loop
-        order = np.argsort(keys, kind="stable")
+        from ..core.tuples import group_by_key
+        order, starts, _g_ends = group_by_key(keys)
         ks = keys[order]
         ps = pos[order]
-        starts = np.concatenate(([0], np.flatnonzero(np.diff(ks)) + 1))
         same_key = np.ones(len(ks), dtype=bool)
         same_key[starts] = False
         in_order = not np.any((np.diff(ps) < 0) & same_key[1:])
         if in_order:
             firsts = ps[starts]
-            lasts_idx = np.concatenate((starts[1:], [len(ks)])) - 1
+            lasts_idx = _g_ends - 1
             ok_heads = True
             for i, s in enumerate(starts):
                 k = int(ks[s])
@@ -67,23 +67,31 @@ class KeyedStreamState:
                     ok_heads = False
                     break
             if ok_heads:
+                # ONE vectorised take of the last row per key, then O(K)
+                # dict stores of views into it (a per-key row.copy() here
+                # costs a python-level copy per distinct key per chunk)
+                lastrows = batch[order[lasts_idx]]
                 for i, li in enumerate(lasts_idx):
-                    sel = order[li]
-                    self.last[int(ks[li])] = (int(ps[li]), batch[sel].copy())
+                    self.last[int(ks[li])] = (int(ps[li]), lastrows[i])
                 return batch
-        # slow path: genuine out-of-order rows — per-key running max
-        keep = np.ones(len(batch), dtype=bool)
-        for k in np.unique(keys):
-            m = keys == k
-            p = pos[m]
-            prev = self.last.get(int(k))
+        # slow path: genuine out-of-order rows — per-key running max over
+        # contiguous sorted slices (O(n + K), not a mask per key)
+        ends = _g_ends
+        keep_sorted = np.ones(len(ks), dtype=bool)
+        for i in range(len(starts)):
+            sl = slice(int(starts[i]), int(ends[i]))
+            p = ps[sl]
+            k = int(ks[starts[i]])
+            prev = self.last.get(k)
             lastpos = prev[0] if prev else _NEG_INF
             runmax = np.maximum.accumulate(np.concatenate(([lastpos], p)))[:-1]
             ok = p >= runmax
-            keep[m] = ok
+            keep_sorted[sl] = ok
             if ok.any():
-                sel = np.flatnonzero(m)[np.flatnonzero(ok)[-1]]
-                self.last[int(k)] = (int(p[ok][-1]), batch[sel].copy())
+                li = int(starts[i]) + int(np.flatnonzero(ok)[-1])
+                self.last[k] = (int(ps[li]), batch[order[li]].copy())
+        keep = np.empty(len(batch), dtype=bool)
+        keep[order] = keep_sorted
         return batch if keep.all() else batch[keep]
 
     def marker_batch(self) -> np.ndarray | None:
