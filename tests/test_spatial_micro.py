@@ -121,3 +121,103 @@ def test_spatial_device_skyline_matches_host():
     farm = run_spatial(WinFarmTPU(device_skyline(), WIN, SLIDE, WinType.TB,
                                   pardegree=2, batch_len=8), batches)
     assert host == farm
+
+
+# ----------------------------------------------------------------- k-means
+
+from windflow_tpu.apps.spatial import (KMEANS_FIELDS, KMeansOverSkylines,
+                                       KMeansWindow, kmeans_lloyd)
+from windflow_tpu.patterns.key_farm import KeyFarm
+
+
+def run_kmeans(pattern, batches):
+    got = {}
+
+    def snk(row):
+        if row is not None:
+            got.setdefault(int(row["key"]), []).append(
+                (int(row["id"]),)
+                + tuple(round(float(row[f]), 9) for f in KMEANS_FIELDS
+                        if f != "iters"))
+
+    df = Dataflow()
+    build_pipeline(df, [Source(batches=batches, schema=POINT_SCHEMA),
+                        pattern, Sink(snk)])
+    df.run_and_wait_end()
+    return got
+
+
+def test_kmeans_lloyd_recovers_separated_clusters():
+    # seed chosen so the deterministic init (the reference's random_my
+    # trades cluster quality for reproducibility) lands one seed per
+    # cluster; other seeds legitimately converge to local optima
+    rng = np.random.default_rng(5)
+    centers = np.array([[0.0, 0.0], [50.0, 0.0], [0.0, 50.0]])
+    pts = np.concatenate([c + rng.normal(0, 0.5, size=(40, 2))
+                          for c in centers])
+    means, clusters, iters = kmeans_lloyd(pts)
+    assert iters >= 1
+    means = means[np.lexsort((means[:, 1], means[:, 0]))]
+    np.testing.assert_allclose(means, centers[[0, 2, 1]], atol=1.0)
+    assert len(np.unique(clusters)) == 3
+
+
+def test_kmeans_small_window_edge_cases():
+    means, cl, it = kmeans_lloyd(np.zeros((0, 2)))
+    assert means.shape == (3, 2) and len(cl) == 0 and it == 0
+    means, cl, it = kmeans_lloyd(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    assert means.shape == (3, 2)   # padded: fewer points than clusters
+
+
+def test_kmeans_window_farms_match_seq():
+    """The NIC-only heavy path: every whole-window composition of the
+    k-means operator equals the sequential core (test_spatial_wf's role
+    with KmeansFunction, dkm.hpp:262-276)."""
+    batches = point_batches(900, keys=2, chunk=128)
+    ref = run_kmeans(WinSeq(KMeansWindow(), WIN, SLIDE, WinType.TB),
+                     iter(batches))
+    assert ref and all(len(v) > 3 for v in ref.values())
+    for comp in (WinFarm(KMeansWindow(), WIN, SLIDE, WinType.TB,
+                         pardegree=3),
+                 KeyFarm(KMeansWindow(), WIN, SLIDE, WinType.TB,
+                         pardegree=2)):
+        got = run_kmeans(comp, iter(batches))
+        assert got == ref, type(comp).__name__
+
+
+def test_kmeans_over_skylines_two_stage():
+    """skyline (full-content payload) -> windowed k-means over the skyline
+    union — the dkm fixture's Iterable<Skyline> signature."""
+    from windflow_tpu.core.windows import PatternConfig, Role
+    batches = point_batches(600, keys=1, chunk=128)
+    # stage 1: per-pane skylines (SkylinePLQ carries the point payload)
+    stage1 = WinSeq(SkylinePLQ(), SLIDE, SLIDE, WinType.TB, name="sky",
+                    role=Role.PLQ, config=PatternConfig.plain(SLIDE))
+    # stage 2: k-means over windows of 4 consecutive skylines
+    stage2 = WinSeq(KMeansOverSkylines(), 4, 1, WinType.CB, name="km")
+    got = {}
+
+    def snk(row):
+        if row is not None:
+            got.setdefault(int(row["key"]), []).append(
+                tuple(round(float(row[f]), 9) for f in KMEANS_FIELDS
+                      if f != "iters"))
+
+    df = Dataflow()
+    build_pipeline(df, [Source(batches=iter(batches), schema=POINT_SCHEMA),
+                        stage1, stage2, Sink(snk)])
+    df.run_and_wait_end()
+    assert got and all(len(v) >= 2 for v in got.values())
+
+
+def test_spatial_pf_opt_levels_match():
+    """test_spatial_pf.cpp's --opt flag: the skyline Pane_Farm produces
+    identical results at LEVEL0/1/2."""
+    batches = point_batches(700, keys=2, chunk=128)
+    outs = []
+    for lvl in (0, 1, 2):
+        pf = PaneFarm(SkylinePLQ(), SkylineWLQ(), WIN, SLIDE, WinType.TB,
+                      plq_degree=2, wlq_degree=2, opt_level=lvl)
+        outs.append(run_spatial(pf, iter(batches)))
+    assert outs[0] == outs[1] == outs[2]
+    assert outs[0]

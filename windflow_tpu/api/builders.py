@@ -393,7 +393,14 @@ class WinMapReduce_Builder(_Builder, _WindowMixin, _TwoStageParMixin):
 class _TPUMixin:
     """Device-path options shared by the five *TPU builders — the
     ``withBatch(batch_len, n_thread_block)`` family of the GPU builders
-    (builders.hpp:987+) retargeted at XLA."""
+    (builders.hpp:987+) retargeted at XLA.
+
+    Note on the native C++ hot loop: the resident device path runs its
+    per-row bookkeeping in C++ (native/wf_native.cpp) only when the
+    reduced payload field is **int64** (the native ABI ships one int64
+    column); other payload dtypes transparently fall back to the pure
+    -Python resident core — same results, slower host loop
+    (patterns/native_core.py:_fall_back)."""
 
     def withBatch(self, batch_len: int, n_thread_block: int = None):
         self._kw["batch_len"] = int(batch_len)

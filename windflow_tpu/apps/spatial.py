@@ -110,6 +110,94 @@ def device_skyline():
                              result_fields=dict(RESULT_FIELDS))
 
 
+# ---------------------------------------------------------------- k-means
+
+#: number of clusters (dkm.hpp N_CENTROIDS)
+N_CENTROIDS = 3
+
+#: centroid result columns: N_CENTROIDS x 2 coordinates, canonically
+#: ordered, plus the Lloyd iteration count
+KMEANS_FIELDS = {f"c{i}{a}": np.float64
+                 for i in range(N_CENTROIDS) for a in ("x", "y")}
+KMEANS_FIELDS["iters"] = np.int64
+
+
+def kmeans_lloyd(pts: np.ndarray, k: int = N_CENTROIDS, seed: int = 1,
+                 max_iters: int = 1000):
+    """Lloyd's k-means with deterministic initialisation — the behavioral
+    re-derivation of the reference's dkm.hpp fixture (kmeans_lloyd,
+    dkm.hpp:236-258: iterate assignment + means until the means stop
+    moving exactly; empty clusters keep their previous mean,
+    :198-221; deterministic seed-point selection replaces kmeans++ for
+    reproducible runs, random_my :151-166).  Vectorised numpy; returns
+    (means (k, d), clusters (n,), iterations)."""
+    n = len(pts)
+    if n == 0:
+        return np.zeros((k, pts.shape[1] if pts.ndim == 2 else 2)), \
+            np.zeros(0, dtype=np.int64), 0
+    if n < k:
+        # the reference asserts data.size() >= k (dkm.hpp:241); windows
+        # smaller than k (EOS partials) pad with the last point instead
+        means = pts[np.minimum(np.arange(k), n - 1)]
+        return means, np.minimum(np.arange(n), k - 1), 0
+    rng = np.random.default_rng(seed)
+    means = pts[rng.choice(n, size=k, replace=False)]
+    it = 0
+    for it in range(1, max_iters + 1):
+        d2 = ((pts[:, None, :] - means[None, :, :]) ** 2).sum(axis=2)
+        cl = d2.argmin(axis=1)
+        new = np.empty_like(means)
+        for c in range(k):
+            m = cl == c
+            new[c] = pts[m].mean(axis=0) if m.any() else means[c]
+        if np.array_equal(new, means):   # exact convergence (dkm.hpp:255)
+            break
+        means = new
+    return means, cl, it
+
+
+def _centroid_payload(means: np.ndarray, iters: int) -> tuple:
+    """Flatten centroids into the fixed result columns, canonically
+    sorted so every parallel composition emits identical rows."""
+    order = np.lexsort((means[:, 1], means[:, 0]))
+    flat = means[order].reshape(-1)
+    return tuple(flat) + (iters,)
+
+
+class KMeansWindow(WindowFunction):
+    """NIC-only heavy window function (dkm.hpp:KmeansFunction): k-means is
+    NOT decomposable — it has no incremental form and no pane
+    decomposition, so this is exactly the workload class that must run on
+    the whole-window NIC path (Win_Farm / Key_Farm; Pane_Farm cannot
+    help — the point of the fixture)."""
+
+    result_fields = dict(KMEANS_FIELDS)
+    required_fields = ("x", "y")
+
+    def apply(self, key, gwid, rows):
+        pts = np.stack([rows["x"], rows["y"]], axis=1) if len(rows) \
+            else np.zeros((0, 2))
+        means, _, iters = kmeans_lloyd(pts)
+        return _centroid_payload(means, iters)
+
+
+class KMeansOverSkylines(WindowFunction):
+    """The fixture's actual signature: k-means over the de-duplicated
+    union of SKYLINE results (KmeansFunction consumes Iterable<Skyline>
+    and a std::set union of their points, dkm.hpp:262-276) — the second
+    stage behind a skyline operator carrying full-content payloads."""
+
+    result_fields = dict(KMEANS_FIELDS)
+    required_fields = ("pts",)
+
+    def apply(self, key, gwid, rows):
+        parts = [p for p in rows["pts"] if p is not None and len(p)]
+        pts = (np.unique(np.concatenate(parts), axis=0) if parts
+               else np.zeros((0, 2)))   # sorted-set union (dkm.hpp:265-269)
+        means, _, iters = kmeans_lloyd(pts)
+        return _centroid_payload(means, iters)
+
+
 def point_batches(n_points, keys=1, chunk=512, seed=7, ts_step=5):
     """Synthetic point stream (sq_generator.hpp analog): uniform points
     with a linear timestamp ramp per key."""

@@ -33,14 +33,18 @@ def _build() -> bool:
     src = os.path.join(_DIR, "wf_native.cpp")
     if not os.path.exists(src):
         return False
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
-        return True
+    # always invoke make: it no-ops when up to date and rebuilds when the
+    # host fingerprint changed (host.tag — a -march=native .so cached on
+    # another CPU would SIGILL; mtime alone cannot see that)
     try:
         subprocess.run(["make", "-C", _DIR], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_SO)
     except Exception:
-        return False
+        # no toolchain: only trust an existing .so that is not stale
+        # relative to the source (the pre-host.tag safety rule)
+        return (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(src))
 
 
 def load():
@@ -52,47 +56,57 @@ def load():
         _tried = True
         if not _build():
             return None
-        lib = ctypes.CDLL(_SO)
-        lib.wf_core_new.restype = ctypes.c_void_p
-        lib.wf_core_new.argtypes = ([i64] * 2 + [ctypes.c_int] * 2
-                                    + [i64] * 11 + [ctypes.c_int])
-        lib.wf_core_free.argtypes = [ctypes.c_void_p]
-        lib.wf_core_process.restype = i64
-        lib.wf_core_process.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                        i64, i64, i64, i64, i64, i64, i64]
-        lib.wf_core_eos.restype = i64
-        lib.wf_core_eos.argtypes = [ctypes.c_void_p]
-        lib.wf_core_force_flush.restype = i64
-        lib.wf_core_force_flush.argtypes = [ctypes.c_void_p]
-        lib.wf_cores_process_mt.restype = i64
-        lib.wf_cores_process_mt.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,
-            i64, i64, i64, i64, i64, i64, i64]
-        lib.wf_launch_pending.restype = i64
-        lib.wf_launch_pending.argtypes = [ctypes.c_void_p]
-        lib.wf_launch_peek.restype = ctypes.c_int
-        lib.wf_launch_peek.argtypes = [ctypes.c_void_p, p_i64, p_i64, p_i64,
-                                       p_int, p_int, p_i64, p_i64]
-        lib.wf_launch_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                       p_i64, p_i32, p_i32, p_i32,
-                                       p_i64, p_i64, p_i64, p_i64]
-        lib.wf_launch_take_padded.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, i64, i64,
-            p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64]
-        lib.wf_launch_peek_regular.restype = ctypes.c_int
-        lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
-        lib.wf_launch_take_regular.argtypes = [ctypes.c_void_p, p_i32,
-                                               p_i32, p_i32, p_i32]
-        lib.wf_queue_new.restype = ctypes.c_void_p
-        lib.wf_queue_new.argtypes = [i64]
-        lib.wf_queue_free.argtypes = [ctypes.c_void_p]
-        lib.wf_queue_push.restype = ctypes.c_int
-        lib.wf_queue_push.argtypes = [ctypes.c_void_p, i64, i64]
-        lib.wf_queue_pop.restype = ctypes.c_int
-        lib.wf_queue_pop.argtypes = [ctypes.c_void_p, p_i64, p_i64]
-        lib.wf_queue_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+        try:
+            return _bind(ctypes.CDLL(_SO))
+        except Exception:
+            # dlopen failure or missing symbol (e.g. a truncated or
+            # older-ABI .so that survived a failed rebuild): fall back to
+            # the pure-Python cores instead of crashing the dataflow
+            return None
+
+
+def _bind(lib):
+    global _lib
+    lib.wf_core_new.restype = ctypes.c_void_p
+    lib.wf_core_new.argtypes = ([i64] * 2 + [ctypes.c_int] * 2
+                                + [i64] * 11 + [ctypes.c_int])
+    lib.wf_core_free.argtypes = [ctypes.c_void_p]
+    lib.wf_core_process.restype = i64
+    lib.wf_core_process.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    i64, i64, i64, i64, i64, i64, i64]
+    lib.wf_core_eos.restype = i64
+    lib.wf_core_eos.argtypes = [ctypes.c_void_p]
+    lib.wf_core_force_flush.restype = i64
+    lib.wf_core_force_flush.argtypes = [ctypes.c_void_p]
+    lib.wf_cores_process_mt.restype = i64
+    lib.wf_cores_process_mt.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,
+        i64, i64, i64, i64, i64, i64, i64]
+    lib.wf_launch_pending.restype = i64
+    lib.wf_launch_pending.argtypes = [ctypes.c_void_p]
+    lib.wf_launch_peek.restype = ctypes.c_int
+    lib.wf_launch_peek.argtypes = [ctypes.c_void_p, p_i64, p_i64, p_i64,
+                                   p_int, p_int, p_i64, p_i64]
+    lib.wf_launch_take.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   p_i64, p_i32, p_i32, p_i32,
+                                   p_i64, p_i64, p_i64, p_i64]
+    lib.wf_launch_take_padded.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, i64, i64,
+        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64]
+    lib.wf_launch_peek_regular.restype = ctypes.c_int
+    lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
+    lib.wf_launch_take_regular.argtypes = [ctypes.c_void_p, p_i32,
+                                           p_i32, p_i32, p_i32]
+    lib.wf_queue_new.restype = ctypes.c_void_p
+    lib.wf_queue_new.argtypes = [i64]
+    lib.wf_queue_free.argtypes = [ctypes.c_void_p]
+    lib.wf_queue_push.restype = ctypes.c_int
+    lib.wf_queue_push.argtypes = [ctypes.c_void_p, i64, i64]
+    lib.wf_queue_pop.restype = ctypes.c_int
+    lib.wf_queue_pop.argtypes = [ctypes.c_void_p, p_i64, p_i64]
+    lib.wf_queue_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
 
 
 def available() -> bool:

@@ -204,6 +204,12 @@ class DeviceWinSeqCore(WinSeqCore):
                         "(win_seq_gpu.hpp supports NIC device functors)")
 
 
+#: (op, result-dtype, acc-dtype) combinations already warned about —
+#: resident cores are built per farm worker / per run, and repeating the
+#: same narrowing warning for each of them is noise (ADVICE r1)
+_ACC_WARNED = set()
+
+
 def select_acc_dtype(reducer: Reducer, compute_dtype) -> np.dtype:
     """Accumulate dtype for the resident device path: int32/float32 by
     default (TPU-native widths), overridable via ``compute_dtype``.  Warns
@@ -224,11 +230,15 @@ def select_acc_dtype(reducer: Reducer, compute_dtype) -> np.dtype:
                 "(jax.config.update('jax_enable_x64', True)); without it "
                 "jax silently truncates device buffers to 32 bits")
     elif reducer.dtype.itemsize > acc.itemsize:
-        import warnings
-        warnings.warn(
-            f"resident device path accumulates in {acc}; {reducer.op} "
-            "results beyond its range will wrap — pass compute_dtype "
-            "for wide ranges", stacklevel=4)
+        key = (reducer.op, reducer.dtype.str, acc.str)
+        if key not in _ACC_WARNED:
+            _ACC_WARNED.add(key)
+            import warnings
+            warnings.warn(
+                f"resident device path accumulates in {acc}; {reducer.op} "
+                "results beyond its range will wrap — pass compute_dtype "
+                "for wide ranges (warned once per configuration)",
+                stacklevel=4)
     return acc
 
 

@@ -150,14 +150,8 @@ class Collector(Node):
         self.emit(batch)
 
 
-class Broadcast(Node):
-    """Replicate every batch to all outputs — the zero-copy refcounted
-    multicast of the reference (multipipe.hpp:50-115) is free here because
-    numpy batches are immutable-by-convention views."""
-
-    def __init__(self, name="broadcast"):
-        super().__init__(name)
-
-    def svc(self, batch, channel=0):
-        for out in range(self.n_outputs):
-            self.emit_to(out, batch)
+# NOTE: the reference's broadcast_node (multipipe.hpp:50-115) has no node
+# here on purpose: it exists only to feed CB-window farms the whole stream
+# inside MultiPipe, and this framework's MultiPipe covers that case with a
+# TS_RENUMBERING ordered merge instead (api/multipipe.py:_maybe_order) —
+# a broadcast + per-worker renumber pair never materialises.

@@ -5,7 +5,9 @@ Host-side dataflow stays on CPU threads exactly like the reference; the
 difference is that channel payloads are whole batches, so queue traffic is
 O(stream/chunk) instead of O(stream), and the Python GIL is released inside
 the numpy/XLA kernels doing the real work.  When the native C++ substrate is
-built (native/), Inbox transparently switches to the lock-free MPSC ring.
+built (native/), Inbox transparently switches to the native blocking MPSC
+ring (mutex + condvar — the win over queue.Queue is GIL-released futex
+waits instead of 50 ms polling, not lock-freedom).
 
 Topology model: a directed graph of Nodes. Each node owns one Inbox; an edge
 (a -> b) reserves a source-slot in b's inbox so b can count per-channel EOS
