@@ -80,7 +80,10 @@ def test_wmr_high_cardinality_differential():
                                             win, win, WinType.CB,
                                             map_degree=2)])
     assert wmr["total"] == kf["total"]
-    assert dt_wmr < 60, f"wmr took {dt_wmr:.1f}s at {N_KEYS} keys"
+    # vectorised cores + collector run this in ~2.5s; 20s leaves headroom
+    # for slow CI hosts while still catching a per-key-loop regression
+    assert dt_wmr < 20, f"wmr took {dt_wmr:.1f}s at {N_KEYS} keys"
+    assert dt_kf < 20, f"kf took {dt_kf:.1f}s at {N_KEYS} keys"
 
 
 def test_accumulator_high_cardinality():

@@ -1,0 +1,162 @@
+"""Differential: VecIncTumblingCore vs the reference per-key WinSeqCore.
+
+The vectorised core must be row-for-row identical (per key) to WinSeqCore
+on tumbling windows for every role / config / reducer / disorder mix it
+claims to support (vec_core_supported)."""
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.tuples import MARKER_FIELD, Schema, batch_from_columns
+from windflow_tpu.core.vecinc import VecIncTumblingCore, vec_core_supported
+from windflow_tpu.core.windows import PatternConfig, Role, WindowSpec, WinType
+from windflow_tpu.core.winseq import WinSeqCore
+from windflow_tpu.ops.functions import MultiReducer, Reducer
+
+SCHEMA = Schema(value=np.int64)
+
+
+def make_stream(rng, n_keys, n_chunks, rows_per_chunk, *, ooo_frac=0.0,
+                gaps=False, markers_at_end=True):
+    """Chunks of interleaved keyed rows with optional disorder and id gaps;
+    the final chunk optionally carries per-key EOS markers (each key's last
+    row replayed with the marker flag, as the farm emitters do)."""
+    next_id = {k: 0 for k in range(n_keys)}
+    last_row = {}
+    chunks = []
+    for _ in range(n_chunks):
+        keys = rng.integers(0, n_keys, rows_per_chunk)
+        ids = np.empty(rows_per_chunk, dtype=np.int64)
+        for i, k in enumerate(keys):
+            step = int(rng.integers(1, 4)) if gaps else 1
+            ids[i] = next_id[k]
+            next_id[k] += step
+        if ooo_frac:
+            flip = rng.random(rows_per_chunk) < ooo_frac
+            ids[flip] = np.maximum(ids[flip] - rng.integers(1, 6, flip.sum()), 0)
+        ts = ids * 3 + keys
+        vals = rng.integers(-5, 50, rows_per_chunk)
+        b = batch_from_columns(SCHEMA, key=keys, id=ids, ts=ts, value=vals)
+        for i in range(rows_per_chunk):
+            k = int(keys[i])
+            if k not in last_row or ids[i] >= int(last_row[k]["id"]):
+                last_row[k] = b[i].copy()
+        chunks.append(b)
+    if markers_at_end and last_row:
+        mk = np.stack([last_row[k] for k in sorted(last_row)])
+        mk[MARKER_FIELD] = True
+        chunks.append(mk)
+    return chunks
+
+
+def run_core(core, chunks):
+    outs = [core.process(c) for c in chunks]
+    outs.append(core.flush())
+    outs = [o for o in outs if len(o)]
+    return (np.concatenate(outs) if outs
+            else np.zeros(0, dtype=core.result_schema.dtype()))
+
+
+def per_key_sorted(res):
+    """Row sequences grouped per key (cross-key emission order is not part
+    of the contract — the reference's is thread-timing dependent too)."""
+    out = {}
+    for k in np.unique(res["key"]):
+        out[int(k)] = res[res["key"] == k]
+    return out
+
+
+def assert_equivalent(a, b):
+    ka, kb = per_key_sorted(a), per_key_sorted(b)
+    assert set(ka) == set(kb)
+    for k in ka:
+        ra, rb = ka[k], kb[k]
+        assert len(ra) == len(rb), f"key {k}: {len(ra)} vs {len(rb)} rows"
+        for f in ra.dtype.names:
+            np.testing.assert_array_equal(
+                ra[f], rb[f], err_msg=f"key {k} field {f}")
+
+
+CASES = [
+    dict(),                                   # in-order, dense
+    dict(ooo_frac=0.15),                      # out-of-order drops
+    dict(gaps=True),                          # id gaps -> empty fired windows
+    dict(gaps=True, ooo_frac=0.1),
+    dict(markers_at_end=False),               # no EOS markers
+]
+
+
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_vec_vs_ref_seq(win_type, case):
+    rng = np.random.default_rng(100 + case)
+    spec = WindowSpec(4, 4, win_type)
+    chunks = make_stream(rng, 37, 6, 200, **CASES[case])
+    red = Reducer("sum")
+    ref = WinSeqCore(spec, red).use_incremental()
+    vec = VecIncTumblingCore(spec, red)
+    assert_equivalent(run_core(vec, chunks), run_core(ref, chunks))
+
+
+@pytest.mark.parametrize("role,map_indexes", [
+    (Role.MAP, (1, 3)), (Role.PLQ, (0, 1)), (Role.WLQ, (0, 1)),
+    (Role.REDUCE, (0, 1)),
+])
+def test_vec_vs_ref_roles(role, map_indexes):
+    rng = np.random.default_rng(7)
+    spec = WindowSpec(5, 5, WinType.CB)
+    cfg = PatternConfig(id_outer=1, n_outer=2, slide_outer=10,
+                        id_inner=1, n_inner=3, slide_inner=5)
+    chunks = make_stream(rng, 23, 5, 150, gaps=True)
+    red = Reducer("max")
+    ref = WinSeqCore(spec, red, config=cfg, role=role,
+                     map_indexes=map_indexes).use_incremental()
+    vec = VecIncTumblingCore(spec, red, config=cfg, role=role,
+                             map_indexes=map_indexes)
+    assert_equivalent(run_core(vec, chunks), run_core(ref, chunks))
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max", "prod", "count"])
+def test_vec_vs_ref_ops(op):
+    rng = np.random.default_rng(11)
+    spec = WindowSpec(3, 3, WinType.CB)
+    chunks = make_stream(rng, 11, 4, 90, ooo_frac=0.1)
+    red = Reducer(op, out_field="r")
+    ref = WinSeqCore(spec, red).use_incremental()
+    vec = VecIncTumblingCore(spec, red)
+    assert_equivalent(run_core(vec, chunks), run_core(ref, chunks))
+
+
+def test_vec_vs_ref_multireducer():
+    rng = np.random.default_rng(13)
+    spec = WindowSpec(6, 6, WinType.TB)
+    chunks = make_stream(rng, 19, 5, 120, gaps=True)
+    mk = MultiReducer(("count", None, "cnt"), ("max", "value", "mx"),
+                      ("sum", "value", "sm"))
+    ref = WinSeqCore(spec, mk).use_incremental()
+    vec = VecIncTumblingCore(spec, mk)
+    assert_equivalent(run_core(vec, chunks), run_core(ref, chunks))
+
+
+def test_vec_core_gate():
+    """make_core picks the vectorised core exactly when supported."""
+    from windflow_tpu.patterns.win_seq import WinSeq
+    assert vec_core_supported(WindowSpec(4, 4, WinType.CB), Reducer("sum"))
+    assert not vec_core_supported(WindowSpec(8, 4, WinType.CB), Reducer("sum"))
+    assert isinstance(WinSeq(Reducer("sum"), 4, 4, WinType.CB).make_core(),
+                      VecIncTumblingCore)
+    assert isinstance(WinSeq(Reducer("sum"), 8, 4, WinType.CB).make_core(),
+                      WinSeqCore)
+
+
+def test_vec_initial_id_drop():
+    """Rows below a worker's initial position are dropped identically."""
+    rng = np.random.default_rng(17)
+    spec = WindowSpec(4, 4, WinType.CB)
+    cfg = PatternConfig(id_outer=1, n_outer=3, slide_outer=4,
+                        id_inner=0, n_inner=1, slide_inner=4)
+    chunks = make_stream(rng, 9, 4, 80)
+    red = Reducer("sum")
+    ref = WinSeqCore(spec, red, config=cfg).use_incremental()
+    vec = VecIncTumblingCore(spec, red, config=cfg)
+    assert_equivalent(run_core(vec, chunks), run_core(ref, chunks))

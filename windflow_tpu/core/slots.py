@@ -1,0 +1,52 @@
+"""Shared key->dense-slot registry for vectorised per-key state.
+
+The multi-key hot paths (VecIncTumblingCore, WFCollectorNode) keep per-key
+state in parallel arrays indexed by a dense slot id.  This helper owns the
+one subtle piece both need: a vectorised lookup that maps a chunk's key
+column to slots, registering first-seen keys in first-appearance order and
+maintaining a sorted view for ``np.searchsorted`` lookups.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SlotMap:
+    """Dense int slots for int64 keys; lookup is O(rows log keys)."""
+
+    __slots__ = ("n", "keys", "_sorted_keys", "_sorted_slots", "_on_register")
+
+    def __init__(self, on_register=None):
+        self.n = 0
+        self.keys = np.zeros(0, dtype=np.int64)      # slot -> key
+        self._sorted_keys = np.zeros(0, dtype=np.int64)
+        self._sorted_slots = np.zeros(0, dtype=np.int64)
+        #: optional hook called with the (m,) array of newly registered keys
+        #: (their slots are n-m .. n-1) — per-key init math goes here
+        self._on_register = on_register
+
+    def _register(self, new_keys: np.ndarray):
+        uniq, first_idx = np.unique(new_keys, return_index=True)
+        k = uniq[np.argsort(first_idx)]              # first-appearance order
+        self.keys = np.concatenate((self.keys[:self.n], k))
+        self.n += len(k)
+        order = np.argsort(self.keys, kind="stable")
+        self._sorted_keys = self.keys[order]
+        self._sorted_slots = order.astype(np.int64)
+        if self._on_register is not None:
+            self._on_register(k)
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Slots for `keys` (int64 array), registering unseen keys."""
+        if self.n:
+            idx = np.searchsorted(self._sorted_keys, keys)
+            idxc = np.minimum(idx, self.n - 1)
+            found = self._sorted_keys[idxc] == keys
+            if found.all():
+                return self._sorted_slots[idxc]
+            self._register(keys[~found])
+        else:
+            self._register(keys)
+        idx = np.searchsorted(self._sorted_keys, keys)
+        return self._sorted_slots[idx]

@@ -1,0 +1,366 @@
+"""Vectorised multi-key incremental core for tumbling windows.
+
+``WinSeqCore`` (core/winseq.py) groups each chunk by key and runs ~20 numpy
+ops per key group — exact, but at 10^5 distinct keys a chunk dissolves into
+10^5 tiny-array calls (~100µs each; the reference pays the same shape of
+cost per tuple, win_seq.hpp:268-474).  For the dominant special case —
+**tumbling window + monoid reducer** (YSB's per-campaign aggregate, the
+Pane_Farm PLQ stage, Win_MapReduce's MAP/REDUCE stages, every sum_test
+tumbling config) — the whole chunk reduces to segment arithmetic:
+
+* a row at relative position ``r`` belongs to exactly window ``r // L``;
+* windows ``[n_fired, max_r // L)`` fire, window ``max_r // L`` stays
+  pending with a partial accumulator (O(1) state per key, like INC mode);
+* per-(key, window) partials are one ``ufunc.reduceat`` over the chunk
+  sorted by key.
+
+Semantics are differentially identical to ``WinSeqCore`` in INC mode (which
+for a monoid equals NIC mode): out-of-order drops against the per-key
+running max (win_seq.hpp:293-305), rows below the worker's ``initial_id``
+dropped (win_seq.hpp:307-314), empty skipped windows fire with the monoid
+identity, EOS markers advance creation/firing and overwrite result
+timestamps without being folded (window.hpp:149-154), PLQ/MAP result-id
+renumbering (win_seq.hpp:396-405).  Per-key state is laid out as parallel
+arrays indexed by a key->slot map instead of per-key objects, so a chunk's
+bookkeeping is O(rows log rows) regardless of key cardinality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tuples import MARKER_FIELD, Schema
+from .windows import PatternConfig, Role, WindowSpec, WinType
+from ..ops.functions import MultiReducer, Reducer
+from ..ops.monoid import NP_UFUNCS, identity as monoid_identity
+
+_NEG_INF = np.int64(-(2 ** 62))
+
+
+def vec_core_supported(spec: WindowSpec, winfunc) -> bool:
+    """The fast path handles tumbling windows + (Multi)Reducer, any role."""
+    if not spec.is_tumbling:
+        return False
+    if isinstance(winfunc, MultiReducer):
+        parts = winfunc.parts
+    elif isinstance(winfunc, Reducer):
+        parts = [winfunc]
+    else:
+        return False
+    return all(p.op == "count" or p.op in NP_UFUNCS for p in parts)
+
+
+def _segments(sorted_vals: np.ndarray):
+    starts = np.concatenate(([0], np.flatnonzero(np.diff(sorted_vals)) + 1))
+    ends = np.concatenate((starts[1:], [len(sorted_vals)]))
+    return starts, ends
+
+
+class VecIncTumblingCore:
+    """Drop-in for WinSeqCore (process/flush/use_incremental contract)."""
+
+    def __init__(self, spec: WindowSpec, winfunc, config: PatternConfig = None,
+                 role: Role = Role.SEQ, map_indexes=(0, 1),
+                 result_ts_slide: int = None):
+        assert vec_core_supported(spec, winfunc)
+        self.spec = spec
+        self.winfunc = winfunc
+        self.config = config or PatternConfig.plain(spec.slide_len)
+        self.role = role
+        self.map_indexes = map_indexes
+        self.result_ts_slide = (result_ts_slide if result_ts_slide is not None
+                                else spec.slide_len)
+        self.is_nic = False
+        self.result_schema = Schema(**winfunc.result_fields)
+        self._result_dtype = self.result_schema.dtype()
+        self.pos_field = "id" if spec.win_type is WinType.CB else "ts"
+        self._L = int(spec.win_len)
+        parts = winfunc.parts if isinstance(winfunc, MultiReducer) else [winfunc]
+        # (out_field, in_field, ufunc-or-None(=count), dtype, identity)
+        self._parts = [(p.out_field, p.field, None if p.op == "count"
+                        else NP_UFUNCS[p.op], p.dtype,
+                        p.dtype.type(monoid_identity(p.op, p.dtype)))
+                       for p in parts]
+        # --- per-key state as parallel arrays (slot-indexed) ---
+        from .slots import SlotMap
+        self._slotmap = SlotMap(on_register=self._init_new_keys)
+        self._n = 0
+        self._cap = 0
+        self._key = np.zeros(0, dtype=np.int64)
+        self._last_pos = np.zeros(0, dtype=np.int64)
+        self._initial = np.zeros(0, dtype=np.int64)
+        self._fgwid = np.zeros(0, dtype=np.int64)
+        self._inner_off = np.zeros(0, dtype=np.int64)   # PLQ renumbering
+        self._nfired = np.zeros(0, dtype=np.int64)      # == pending lwid
+        self._seen = np.zeros(0, dtype=bool)
+        self._emit_ctr = np.zeros(0, dtype=np.int64)    # MAP/PLQ renumbering
+        self._marker_pos = np.zeros(0, dtype=np.int64)
+        self._marker_ts = np.zeros(0, dtype=np.int64)
+        self._acc_ts = np.zeros(0, dtype=np.int64)      # last folded ts, pending
+        self._acc = {of: np.zeros(0, dtype=dt)
+                     for of, _f, _u, dt, _i in self._parts}
+
+    def use_incremental(self):
+        return self  # inherently incremental
+
+    # ------------------------------------------------------------- key slots
+
+    def _grow(self, need: int):
+        cap = max(self._cap * 2, need, 1024)
+
+        def g(a, fill=0):
+            b = np.full(cap, fill, dtype=a.dtype)
+            b[:self._n] = a[:self._n]
+            return b
+
+        self._key = g(self._key)
+        self._last_pos = g(self._last_pos, _NEG_INF)
+        self._initial = g(self._initial)
+        self._fgwid = g(self._fgwid)
+        self._inner_off = g(self._inner_off)
+        self._nfired = g(self._nfired)
+        self._seen = g(self._seen, False)
+        self._emit_ctr = g(self._emit_ctr)
+        self._marker_pos = g(self._marker_pos, _NEG_INF)
+        self._marker_ts = g(self._marker_ts)
+        self._acc_ts = g(self._acc_ts)
+        for (of, _f, _u, _dt, ident) in self._parts:
+            self._acc[of] = g(self._acc[of], ident)
+        self._cap = cap
+
+    def _init_new_keys(self, k: np.ndarray):
+        """SlotMap registration hook: per-key distribution math vectorised
+        (PatternConfig.first_gwid / initial_id, basic.hpp:136,
+        win_seq.hpp:307-314); new slots are self._n .. self._n+len(k)-1."""
+        m = len(k)
+        if self._n + m > self._cap:
+            self._grow(self._n + m)
+        c = self.config
+        sl = slice(self._n, self._n + m)
+        no, ni = c.n_outer, c.n_inner
+        a = (c.id_inner - (k % ni) + ni) % ni
+        b = (c.id_outer - (k % no) + no) % no
+        self._key[sl] = k
+        self._fgwid[sl] = a * no + b
+        self._inner_off[sl] = a
+        if self.role in (Role.WLQ, Role.REDUCE):
+            self._initial[sl] = a * c.slide_inner
+        else:
+            self._initial[sl] = b * c.slide_outer + a * c.slide_inner
+        if self.role is Role.MAP:
+            self._emit_ctr[sl] = self.map_indexes[0]
+        self._n += m
+
+    def _slots_for(self, keys: np.ndarray) -> np.ndarray:
+        return self._slotmap.lookup(keys)
+
+    # ------------------------------------------------------------- processing
+
+    def process(self, batch: np.ndarray) -> np.ndarray:
+        if len(batch) == 0:
+            return np.zeros(0, dtype=self._result_dtype)
+        keys = batch["key"].astype(np.int64, copy=False)
+        pos = batch[self.pos_field].astype(np.int64, copy=False)
+        slots = self._slots_for(keys)
+        order = np.argsort(slots, kind="stable")
+        s = slots[order]
+        p = pos[order]
+        starts, ends = _segments(s)
+        # --- out-of-order drop against the per-key running max ---
+        seg_first = np.zeros(len(s), dtype=bool)
+        seg_first[starts] = True
+        within_bad = np.zeros(len(s), dtype=bool)
+        within_bad[1:] = (np.diff(p) < 0) & ~seg_first[1:]
+        head_bad = p[starts] < self._last_pos[s[starts]]
+        keep_s = None
+        if within_bad.any() or head_bad.any():
+            keep_s = np.ones(len(s), dtype=bool)
+            bad_idx = np.flatnonzero(
+                head_bad | (np.add.reduceat(within_bad, starts) > 0))
+            for i in bad_idx:  # rare: only genuinely out-of-order segments
+                sl = slice(int(starts[i]), int(ends[i]))
+                runmax = np.maximum.accumulate(np.concatenate(
+                    ([self._last_pos[s[starts[i]]]], p[sl])))[:-1]
+                keep_s[sl] = p[sl] >= runmax
+        # update last_pos from surviving rows (win_seq.hpp updates it before
+        # the initial_id filter)
+        if keep_s is None:
+            self._last_pos[s[starts]] = np.maximum(
+                self._last_pos[s[starts]], p[ends - 1])
+        else:
+            liv = np.flatnonzero(keep_s)
+            if len(liv) == 0:
+                return np.zeros(0, dtype=self._result_dtype)
+            ls, le = _segments(s[liv])
+            self._last_pos[s[liv[ls]]] = np.maximum(
+                self._last_pos[s[liv[ls]]], p[liv[le - 1]])
+        # --- drop rows below the worker's initial position ---
+        below = p < self._initial[s]
+        if below.any():
+            keep_s = ~below if keep_s is None else keep_s & ~below
+        if keep_s is not None:
+            sub = np.flatnonzero(keep_s)
+            if len(sub) == 0:
+                return np.zeros(0, dtype=self._result_dtype)
+            order = order[sub]
+            s = s[sub]
+            p = p[sub]
+            starts, ends = _segments(s)
+        sorted_rows = batch[order]
+        mk = sorted_rows[MARKER_FIELD]
+        rel = p - self._initial[s]
+        w = rel // self._L
+        # --- markers: remember the last marker's pos/ts per key ---
+        any_mk = bool(mk.any())
+        if any_mk:
+            mi = np.flatnonzero(mk)
+            msl = s[mi]
+            last = np.ones(len(mi), dtype=bool)
+            last[:-1] = msl[1:] != msl[:-1]
+            self._marker_pos[msl[last]] = p[mi[last]]
+            self._marker_ts[msl[last]] = \
+                sorted_rows["ts"][mi[last]].astype(np.int64)
+        # --- per-(slot, window) fold segments over real (non-marker) rows ---
+        if any_mk:
+            ri = np.flatnonzero(~mk)
+            r_s, r_w, r_rows = s[ri], w[ri], sorted_rows[ri]
+        else:
+            r_s, r_w, r_rows = s, w, sorted_rows
+        if len(r_s):
+            bnd = np.concatenate(([0], np.flatnonzero(
+                (np.diff(r_s) != 0) | (np.diff(r_w) != 0)) + 1))
+            bnd_end = np.concatenate((bnd[1:], [len(r_s)]))
+            seg_slot = r_s[bnd]
+            seg_w = r_w[bnd]
+            seg_len = bnd_end - bnd
+            seg_ts = r_rows["ts"][bnd_end - 1].astype(np.int64)
+            seg_vals = {}
+            for (of, field, ufunc, dt, _ident) in self._parts:
+                if ufunc is None:
+                    seg_vals[of] = seg_len.astype(dt)
+                else:
+                    seg_vals[of] = ufunc.reduceat(
+                        r_rows[field].astype(dt, copy=False), bnd)
+        else:
+            seg_slot = seg_w = np.zeros(0, dtype=np.int64)
+            seg_ts = np.zeros(0, dtype=np.int64)
+            seg_vals = {of: np.zeros(0, dtype=dt)
+                        for (of, _f, _u, dt, _i) in self._parts}
+        # --- firing: windows [n_fired, w_max) fire; w_max stays pending ---
+        u = s[starts]                       # unique slots, ascending
+        w_max = w[ends - 1]                 # fired_before(max_rel), tumbling
+        fired_lo = self._nfired[u]
+        m = w_max - fired_lo                # >= 0: kept rows are in-order
+        self._seen[u] = True
+        total = int(m.sum())
+        offs = np.concatenate(([0], np.cumsum(m)))
+        out_slot = np.repeat(u, m)
+        ar = np.arange(total, dtype=np.int64) - np.repeat(offs[:-1], m)
+        out_lwid = np.repeat(fired_lo, m) + ar
+        out_vals = {of: np.full(total, ident, dtype=dt)
+                    for (of, _f, _u, dt, ident) in self._parts}
+        out_ts = np.zeros(total, dtype=np.int64)
+        # the old pending accumulator lands in each slot's first fired window
+        moved = m > 0
+        if moved.any():
+            pp = offs[:-1][moved]
+            mu = u[moved]
+            for (of, _f, ufunc, dt, ident) in self._parts:
+                accv = self._acc[of][mu]
+                if ufunc is None:           # count: partials add
+                    out_vals[of][pp] = out_vals[of][pp] + accv
+                else:
+                    out_vals[of][pp] = ufunc(out_vals[of][pp], accv)
+                self._acc[of][mu] = ident
+            out_ts[pp] = self._acc_ts[mu]
+            self._acc_ts[mu] = 0
+        # fold chunk segments into fired outputs / the pending accumulator
+        if len(seg_slot):
+            spos = np.searchsorted(u, seg_slot)
+            fired_seg = seg_w < w_max[spos]
+            if fired_seg.any():
+                fs = np.flatnonzero(fired_seg)
+                op = offs[:-1][spos[fs]] + (seg_w[fs] - fired_lo[spos[fs]])
+                for (of, _f, ufunc, dt, _ident) in self._parts:
+                    sv = seg_vals[of][fs]
+                    if ufunc is None:
+                        out_vals[of][op] = out_vals[of][op] + sv
+                    else:
+                        out_vals[of][op] = ufunc(out_vals[of][op], sv)
+                out_ts[op] = seg_ts[fs]
+            pend = ~fired_seg
+            if pend.any():
+                ps = np.flatnonzero(pend)
+                psl = seg_slot[ps]
+                for (of, _f, ufunc, dt, _ident) in self._parts:
+                    sv = seg_vals[of][ps]
+                    if ufunc is None:
+                        self._acc[of][psl] = self._acc[of][psl] + sv
+                    else:
+                        self._acc[of][psl] = ufunc(self._acc[of][psl], sv)
+                self._acc_ts[psl] = seg_ts[ps]
+        self._nfired[u] = w_max
+        if total == 0:
+            return np.zeros(0, dtype=self._result_dtype)
+        return self._make_results(out_slot, out_lwid, out_ts, out_vals)
+
+    # ------------------------------------------------------------------- emit
+
+    def _make_results(self, out_slot, out_lwid, out_ts, vals) -> np.ndarray:
+        """Assemble a result batch: gwids, role renumbering
+        (win_seq.hpp:396-405), CB marker ts overwrite (window.hpp:149-154),
+        TB closed-form ts.  ``out_slot`` must be grouped (all of a slot's
+        windows contiguous, lwids ascending)."""
+        gwids = self._fgwid[out_slot] + out_lwid * self.config.gwid_stride()
+        if self.spec.win_type is WinType.TB:
+            ts = gwids * self.result_ts_slide + self.spec.win_len - 1
+        else:
+            ends_abs = (out_lwid + 1) * self._L + self._initial[out_slot]
+            mpos = self._marker_pos[out_slot]
+            ts = np.where((mpos > _NEG_INF) & (mpos < ends_abs),
+                          self._marker_ts[out_slot], out_ts)
+        if self.role in (Role.MAP, Role.PLQ):
+            first = np.ones(len(out_slot), dtype=bool)
+            first[1:] = out_slot[1:] != out_slot[:-1]
+            fidx = np.flatnonzero(first)
+            cnt = np.diff(np.concatenate((fidx, [len(out_slot)])))
+            rank = out_lwid - np.repeat(out_lwid[fidx], cnt)
+            if self.role is Role.MAP:
+                n = self.map_indexes[1]
+                ids = self._emit_ctr[out_slot] + rank * n
+                self._emit_ctr[out_slot[fidx]] += cnt * n
+            else:
+                ni = self.config.n_inner
+                ids = (self._inner_off[out_slot]
+                       + (self._emit_ctr[out_slot] + rank) * ni)
+                self._emit_ctr[out_slot[fidx]] += cnt
+        else:
+            ids = gwids
+        out = np.zeros(len(out_slot), dtype=self._result_dtype)
+        out["key"] = self._key[out_slot]
+        out["id"] = ids
+        out["ts"] = ts
+        for name in self.winfunc.result_fields:
+            out[name] = vals[name]
+        return out
+
+    # -------------------------------------------------------------------- EOS
+
+    def flush(self) -> np.ndarray:
+        """Emit the pending window of every key that saw rows
+        (win_seq.hpp:433-474); tumbling INC mode has exactly one open
+        window per key."""
+        slots = np.flatnonzero(self._seen[:self._n])
+        if len(slots) == 0:
+            return np.zeros(0, dtype=self._result_dtype)
+        out_lwid = self._nfired[slots].copy()
+        out_ts = self._acc_ts[slots].copy()
+        vals = {of: self._acc[of][slots].copy()
+                for (of, _f, _u, _dt, _i) in self._parts}
+        out = self._make_results(slots, out_lwid, out_ts, vals)
+        self._nfired[slots] += 1
+        self._seen[slots] = False
+        for (of, _f, _u, dt, ident) in self._parts:
+            self._acc[of][slots] = ident
+        self._acc_ts[slots] = 0
+        return out

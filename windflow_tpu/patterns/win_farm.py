@@ -94,40 +94,79 @@ class WFEmitterNode(Node):
 
 class WFCollectorNode(Node):
     """Ordered collector: per-key reorder over dense result ids
-    (wf_nodes.hpp:401-468), batch-native — pending rows are kept as column
-    chunks and the releasable contiguous id-run is found vectorised."""
+    (wf_nodes.hpp:401-468), fully vectorised — pending rows of ALL keys are
+    one buffer; the releasable contiguous id-run per key is a segmented
+    prefix test over a (key, id) lexsort, and each svc emits at most ONE
+    batch (per-key tiny emits would turn 10^5 keys into 10^5 downstream
+    svc calls)."""
 
     def __init__(self, name="wf_collector"):
         super().__init__(name)
-        self._keys = {}  # key -> [next_win, list-of-pending-chunks]
+        from ..core.slots import SlotMap
+        self._slots = SlotMap(on_register=self._on_register)
+        self._next = np.zeros(0, dtype=np.int64)   # slot -> next expected id
+        self._pend_rows = None                     # structured array
+        self._pend_slots = np.zeros(0, dtype=np.int64)
+
+    def _on_register(self, new_keys):
+        self._next = np.concatenate(
+            (self._next, np.zeros(len(new_keys), dtype=np.int64)))
 
     def svc(self, batch, channel=0):
-        outs = []
-        keys = batch["key"]
-        order = np.argsort(keys, kind="stable")
-        sk = keys[order]
-        bounds = np.flatnonzero(np.diff(sk)) + 1
-        for grp in np.split(order, bounds):
-            key = int(keys[grp[0]])
-            st = self._keys.get(key)
-            if st is None:
-                st = self._keys[key] = [0, []]
-            st[1].append(batch[grp])
-            pend = st[1][0] if len(st[1]) == 1 else np.concatenate(st[1])
-            ids = pend["id"]
-            o = np.argsort(ids, kind="stable")
-            sorted_ids = ids[o]
-            # longest contiguous run next, next+1, ... (ids are dense/unique)
-            run = sorted_ids == st[0] + np.arange(len(sorted_ids))
-            k = len(run) if run.all() else int(np.argmin(run))
-            if k:
-                outs.append(pend[o[:k]])
-                st[0] += k
-                st[1] = [pend[o[k:]]] if k < len(pend) else []
+        slots = self._slots.lookup(batch["key"].astype(np.int64, copy=False))
+        if self._pend_rows is not None and len(self._pend_rows):
+            # only slots present in this batch can make progress (release
+            # needs new rows; _next only advances on release) — leave the
+            # rest of the pending buffer untouched instead of re-sorting it
+            touched = np.isin(self._pend_slots, slots)
+            if touched.any():
+                rows = np.concatenate((self._pend_rows[touched], batch))
+                slots = np.concatenate((self._pend_slots[touched], slots))
+                unt = ~touched
+                self._pend_rows = self._pend_rows[unt] if unt.any() else None
+                self._pend_slots = (self._pend_slots[unt] if unt.any()
+                                    else np.zeros(0, dtype=np.int64))
             else:
-                st[1] = [pend]
-        for o in outs:
-            self.emit(o)
+                rows = batch
+        else:
+            rows = batch
+        ids = rows["id"].astype(np.int64, copy=False)
+        order = np.lexsort((ids, slots))
+        s = slots[order]
+        sid = ids[order]
+        starts = np.concatenate(([0], np.flatnonzero(np.diff(s)) + 1))
+        rank = np.arange(len(s), dtype=np.int64)
+        rank -= np.repeat(starts, np.diff(np.concatenate((starts, [len(s)]))))
+        ok = sid == self._next[s] + rank
+        # release the per-segment all-ok prefix: rows before a segment's
+        # first gap (segmented cumulative-bad == 0)
+        bad_cum = np.cumsum(~ok)
+        seg_base = np.repeat(bad_cum[starts] - (~ok[starts]),
+                             np.diff(np.concatenate((starts, [len(s)]))))
+        release = (bad_cum - seg_base) == 0
+        if release.any():
+            n_rel = np.add.reduceat(release, starts)
+            u = s[starts]
+            self._next[u] += n_rel
+            out = rows[order[release]]
+            keep = ~release
+            held = rows[order[keep]] if keep.any() else None
+            held_slots = slots[order[keep]] if keep.any() else None
+            self._stash(held, held_slots)
+            self.emit(out)
+        else:
+            self._stash(rows[order], s)
+
+    def _stash(self, held, held_slots):
+        """Park unreleased rows, joining any untouched pending buffer."""
+        if held is None:
+            return  # untouched pending (if any) already lives in _pend_rows
+        if self._pend_rows is not None and len(self._pend_rows):
+            self._pend_rows = np.concatenate((self._pend_rows, held))
+            self._pend_slots = np.concatenate((self._pend_slots, held_slots))
+        else:
+            self._pend_rows = held
+            self._pend_slots = held_slots
 
 
 class _OrderedWorkerNode(WinSeqNode):

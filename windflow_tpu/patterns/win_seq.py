@@ -66,6 +66,18 @@ class WinSeq(_Pattern):
         self.map_indexes = map_indexes
 
     def make_core(self) -> WinSeqCore:
+        # Tumbling windows over a monoid reducer take the vectorised
+        # multi-key core: identical INC semantics (== NIC for a monoid),
+        # O(rows) per chunk regardless of key cardinality. WF_NO_VECCORE=1
+        # forces the reference per-key core (debugging / differential runs).
+        import os
+        from ..core.vecinc import VecIncTumblingCore, vec_core_supported
+        if (vec_core_supported(self.spec, self.winfunc)
+                and not os.environ.get("WF_NO_VECCORE")):
+            return VecIncTumblingCore(
+                self.spec, self.winfunc, config=self.config, role=self.role,
+                map_indexes=self.map_indexes,
+                result_ts_slide=self.result_ts_slide)
         core = WinSeqCore(self.spec, self.winfunc, config=self.config,
                           role=self.role, map_indexes=self.map_indexes,
                           result_ts_slide=self.result_ts_slide)
