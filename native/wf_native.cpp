@@ -109,9 +109,11 @@ struct Launch {
     // each pending window to its index within its key (host-side gather)
     int regular = 0;
     i64 cmax = 0;
+    int mult = 1;   // coalescing multiplicity (buddy scheme: 1, 2, 4, ...)
     std::vector<int32_t> rcount, rstart0, rlen, widx;   // K, K, K, B
     std::vector<u8> blk;              // K*R in wire dtype
     std::vector<i64> offs;            // K ring write offsets
+    std::vector<int32_t> rows;        // K per-key valid row counts in blk
     std::vector<int32_t> wrows, wstarts, wlens;   // B window descriptors
     std::vector<i64> hkey, hid, hts, hlen;        // B result headers
 };
@@ -290,11 +292,13 @@ struct Core {
         const i64 Rr = std::max<i64>(R, 1);
         L.blk.assign((size_t)(K * Rr * isz), 0);
         L.offs.assign((size_t)K, 0);
+        L.rows.assign((size_t)K, 0);
         for (auto &st : keys) {
             i64 live_start = st.appended - (i64)st.live();
             size_t j0 = st.start + (size_t)(st.launched - live_start);
             i64 cnt = (i64)(st.pos.size() - j0);
             L.offs[(size_t)st.row] = st.launched - st.ring_base;
+            L.rows[(size_t)st.row] = (int32_t)cnt;
             u8 *dst = L.blk.data() + (size_t)(st.row * Rr * isz);
             const i64 *src = st.val.data() + j0;
             if (L.wire == 0)
@@ -685,6 +689,200 @@ i64 wf_launch_pending(void *h) {
     Core *c = (Core *)h;
     std::lock_guard<std::mutex> lk(c->qmu);
     return (i64)c->queue.size();
+}
+
+// --------------------------------------------------------------- coalescing
+// Merge adjacent queued launches into one bigger dispatch.  Over the
+// tunneled device each dispatch pays an amortized RTT regardless of size
+// (BASELINE.md wire characterization), so when the wire falls behind and
+// launches pile up, fusing them trades per-dispatch latency for fewer
+// round trips — the adaptive form of a larger flush_rows.  Only regular
+// launches merge (their per-key window sequences stay arithmetic:
+// start02 == start01 + count1*slide), never across a ring rebase.
+
+static inline i64 rd_elem(const u8 *p, int wire, i64 i) {
+    switch (wire) {
+        case 0: return ((const int8_t *)p)[i];
+        case 1: return ((const int16_t *)p)[i];
+        case 2: return ((const int32_t *)p)[i];
+        default: return ((const i64 *)p)[i];
+    }
+}
+
+static inline void wr_elem(u8 *p, int wire, i64 i, i64 v) {
+    switch (wire) {
+        case 0: ((int8_t *)p)[i] = (int8_t)v; break;
+        case 1: ((int16_t *)p)[i] = (int16_t)v; break;
+        case 2: ((int32_t *)p)[i] = (int32_t)v; break;
+        default: ((i64 *)p)[i] = v; break;
+    }
+}
+
+// merge B into A (A dispatched first; B's rows append right after A's in
+// ring order, B's windows continue A's arithmetic window sequences).
+// Returns false — leaving both untouched — when the pair is incompatible.
+static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells) {
+    if (!A.regular || !B.regular || B.rebase) return false;
+    if (A.KP != B.KP || A.cap != B.cap) return false;
+    // buddy rule: only equal-multiplicity launches merge, so merged sizes
+    // stay at power-of-2 multiples of flush_rows and the device sees a
+    // SMALL, warmup-coverable set of shape buckets (a free-form merge
+    // produces odd multiplicities whose first dispatch compiles for ~10s
+    // over the tunnel — measured — wrecking the run that hits it).
+    // Multiplicity caps at 4: one dispatch then carries ≤4 RTTs' worth of
+    // work, and the bucket ladder stays {1x, 2x, 4x}.  (A cell budget
+    // relative to flush_rows would silently disable merging whenever the
+    // padded K*bucket(R) rectangle dwarfs the row count — many keys, or
+    // one hot key — so the area guard below is absolute instead.)
+    if (A.mult != B.mult || A.mult >= 4) return false;
+    const i64 K2 = std::max(A.K, B.K);
+    // per-key continuity + merged width
+    i64 newR = 1, maxoff = 0;
+    for (i64 k = 0; k < K2; ++k) {
+        const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
+        const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
+        const i64 ca = k < A.K ? A.rcount[(size_t)k] : 0;
+        const i64 cb = k < B.K ? B.rcount[(size_t)k] : 0;
+        if (ca && cb) {
+            if (B.rlen[(size_t)k] != A.rlen[(size_t)k]) return false;
+            if (B.rstart0[(size_t)k]
+                != A.rstart0[(size_t)k] + (int32_t)(ca * slide))
+                return false;
+        }
+        newR = std::max(newR, ra + rb);
+        maxoff = std::max(maxoff,
+                          k < A.K ? A.offs[(size_t)k] : B.offs[(size_t)k]);
+    }
+    if (K2 * bucket(newR) > max_cells) return false;
+    // the Python-side overflow guard is offs.max() + bucket(R) <= cap;
+    // respect the same conservative bound so a merged launch never trips it
+    if (maxoff + bucket(newR) > A.cap) return false;
+    const int wire2 = std::max(A.wire, B.wire);
+    const i64 isz2 = 1LL << wire2;
+    std::vector<u8> nblk((size_t)(K2 * newR * isz2), 0);
+    for (i64 k = 0; k < K2; ++k) {
+        const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
+        const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
+        u8 *dst = nblk.data() + (size_t)(k * newR * isz2);
+        if (ra) {
+            const u8 *src = A.blk.data() + (size_t)(k * A.R << A.wire);
+            if (A.wire == wire2)
+                std::memcpy(dst, src, (size_t)(ra * isz2));
+            else
+                for (i64 i = 0; i < ra; ++i)
+                    wr_elem(dst, wire2, i, rd_elem(src, A.wire, i));
+        }
+        if (rb) {
+            const u8 *src = B.blk.data() + (size_t)(k * B.R << B.wire);
+            if (B.wire == wire2)
+                std::memcpy(dst + (size_t)(ra * isz2), src,
+                            (size_t)(rb * isz2));
+            else
+                for (i64 i = 0; i < rb; ++i)
+                    wr_elem(dst, wire2, ra + i, rd_elem(src, B.wire, i));
+        }
+    }
+    // merged per-key state: offsets are A's (B's new keys keep B's),
+    // counts add, window sequences concatenate
+    std::vector<i64> noffs((size_t)K2, 0);
+    std::vector<int32_t> nrows((size_t)K2, 0), nrc((size_t)K2, 0),
+        nrs0((size_t)K2, 0), nrl((size_t)K2, 0);
+    i64 cmax = 0;
+    for (i64 k = 0; k < K2; ++k) {
+        const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
+        const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
+        const i64 ca = k < A.K ? A.rcount[(size_t)k] : 0;
+        const i64 cb = k < B.K ? B.rcount[(size_t)k] : 0;
+        noffs[(size_t)k] = k < A.K ? A.offs[(size_t)k] : B.offs[(size_t)k];
+        nrows[(size_t)k] = (int32_t)(ra + rb);
+        nrc[(size_t)k] = (int32_t)(ca + cb);
+        nrs0[(size_t)k] = ca ? A.rstart0[(size_t)k]
+                             : (cb ? B.rstart0[(size_t)k] : 0);
+        nrl[(size_t)k] = ca ? A.rlen[(size_t)k]
+                            : (cb ? B.rlen[(size_t)k] : 0);
+        cmax = std::max<i64>(cmax, ca + cb);
+    }
+    // B's windows index after A's within each key
+    const i64 B1 = A.B, B2 = B.B;
+    A.widx.resize((size_t)(B1 + B2));
+    for (i64 i = 0; i < B2; ++i) {
+        const i64 r = B.wrows[(size_t)i];
+        const i64 base = r < A.K ? A.rcount[(size_t)r] : 0;
+        A.widx[(size_t)(B1 + i)] = B.widx[(size_t)i] + (int32_t)base;
+    }
+    auto cat32 = [](std::vector<int32_t> &a, const std::vector<int32_t> &b) {
+        a.insert(a.end(), b.begin(), b.end());
+    };
+    auto cat64 = [](std::vector<i64> &a, const std::vector<i64> &b) {
+        a.insert(a.end(), b.begin(), b.end());
+    };
+    cat32(A.wrows, B.wrows);
+    cat32(A.wstarts, B.wstarts);
+    cat32(A.wlens, B.wlens);
+    cat64(A.hkey, B.hkey);
+    cat64(A.hid, B.hid);
+    cat64(A.hts, B.hts);
+    cat64(A.hlen, B.hlen);
+    A.blk = std::move(nblk);
+    A.offs = std::move(noffs);
+    A.rows = std::move(nrows);
+    A.rcount = std::move(nrc);
+    A.rstart0 = std::move(nrs0);
+    A.rlen = std::move(nrl);
+    A.cmax = cmax;
+    A.wire = wire2;
+    A.K = K2;
+    A.R = newR;
+    A.B = B1 + B2;
+    A.mult *= 2;
+    return true;
+}
+
+// Fuse adjacent queued launch pairs (buddy scheme) while merged
+// rectangles stay under max_cells (K * R cells), up to max_merge merges.
+// Consumer-side only (the one ship thread consumes; the producer only
+// push_backs), so popping interior pairs is race-free; the heavy merge
+// runs outside the queue lock so the producer's flush() never stalls
+// behind it.  Returns the number of merges performed.
+i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge) {
+    Core *c = (Core *)h;
+    i64 merged = 0;
+    size_t i = 0;
+    while (merged < max_merge) {
+        Launch A, B;
+        {
+            std::lock_guard<std::mutex> lk(c->qmu);
+            // find the next adjacent candidate pair at or after i
+            while (i + 1 < c->queue.size()) {
+                Launch &a = c->queue[i], &b = c->queue[i + 1];
+                if (a.regular && b.regular && !b.rebase
+                    && a.mult == b.mult)
+                    break;
+                ++i;
+            }
+            if (i + 1 >= c->queue.size()) break;
+            A = std::move(c->queue[i]);
+            B = std::move(c->queue[i + 1]);
+            c->queue.erase(c->queue.begin() + i, c->queue.begin() + i + 2);
+        }
+        const bool ok = try_merge(A, B, c->slide, max_cells);
+        {
+            std::lock_guard<std::mutex> lk(c->qmu);
+            if (!ok) {
+                c->queue.insert(c->queue.begin() + i, std::move(B));
+                c->queue.insert(c->queue.begin() + i, std::move(A));
+            } else {
+                c->queue.insert(c->queue.begin() + i, std::move(A));
+            }
+        }
+        if (ok) {
+            ++merged;
+            i = 0;   // the merged launch may now neighbor an equal buddy
+        } else {
+            ++i;     // this pair can never merge; move on
+        }
+    }
+    return merged;
 }
 
 int wf_launch_peek(void *h, i64 *K, i64 *R, i64 *B, int *wire, int *rebase,

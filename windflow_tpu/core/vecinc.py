@@ -174,14 +174,24 @@ class VecIncTumblingCore:
         head_bad = p[starts] < self._last_pos[s[starts]]
         keep_s = None
         if within_bad.any() or head_bad.any():
-            keep_s = np.ones(len(s), dtype=bool)
-            bad_idx = np.flatnonzero(
-                head_bad | (np.add.reduceat(within_bad, starts) > 0))
-            for i in bad_idx:  # rare: only genuinely out-of-order segments
-                sl = slice(int(starts[i]), int(ends[i]))
-                runmax = np.maximum.accumulate(np.concatenate(
-                    ([self._last_pos[s[starts[i]]]], p[sl])))[:-1]
-                keep_s[sl] = p[sl] >= runmax
+            # segmented exclusive running max by doubling (O(rows log rows),
+            # no per-key Python even when every segment is disordered):
+            # q becomes the per-segment inclusive prefix max of p seeded
+            # with last_pos at segment heads; the exclusive shift of q is
+            # the reference's runmax (winseq.py _process_key)
+            q = p.copy()
+            q[starts] = np.maximum(q[starts], self._last_pos[s[starts]])
+            sh = 1
+            n_rows = len(q)
+            while sh < n_rows:
+                same = s[sh:] == s[:-sh]
+                np.maximum(q[sh:], np.where(same, q[:-sh], q[sh:]),
+                           out=q[sh:])
+                sh *= 2
+            excl = np.empty(n_rows, dtype=np.int64)
+            excl[1:] = q[:-1]
+            excl[starts] = self._last_pos[s[starts]]
+            keep_s = p >= excl
         # update last_pos from surviving rows (win_seq.hpp updates it before
         # the initial_id filter)
         if keep_s is None:

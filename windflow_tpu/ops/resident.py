@@ -348,6 +348,12 @@ class ResidentWindowExecutor:
         ready, self._ready = self._ready, []
         return ready
 
+    def unready_count(self) -> int:
+        """Dispatches still being serviced by the device/wire (the ship
+        throttle's saturation signal)."""
+        return sum(1 for entry in self._inflight
+                   if not self._is_ready(entry[2]))
+
     @staticmethod
     def _is_ready(out) -> bool:
         try:

@@ -12,6 +12,7 @@ int64 column) or the native library cannot be built.
 from __future__ import annotations
 
 import ctypes
+import os
 import queue as _queue
 import threading
 import time
@@ -130,6 +131,17 @@ class NativeResidentCore:
         #: throttles — restores the backpressure the synchronous ship loop
         #: provided (each queued Launch holds a staged K*R block)
         self._max_pending = 2 * depth
+        #: adaptive launch coalescing (wf_launch_coalesce): keep at most
+        #: this many dispatches in flight un-serviced; beyond it, hold so
+        #: the C++ queue deepens and queued launches fuse into fewer,
+        #: larger dispatches (each dispatch costs an amortized wire RTT —
+        #: BASELINE.md — so under stall fewer round trips win)
+        self._dispatch_window = 4
+        #: absolute merged-rectangle area guard (cells = K * bucket(R));
+        #: the real merge bound is the buddy multiplicity cap of 4 in
+        #: try_merge — this only stops pathological padded rectangles
+        #: (one hot key at huge flush_rows) from quadrupling host memory
+        self._coalesce_cells = 1 << 23
         if self._overlap:
             self._out_q = _queue.SimpleQueue()
             # one ship thread per shard: each owns its executor, so the
@@ -166,7 +178,7 @@ class NativeResidentCore:
     def _ship_token(self, tok, shard):
         kind, ev = tok
         try:
-            while self._ship_launch(shard):
+            while self._ship_launch(shard, force=(kind == "drain")):
                 pass
             got = (self.executors[shard].drain() if kind == "drain"
                    else self.executors[shard].poll())
@@ -260,11 +272,18 @@ class NativeResidentCore:
                 q.put(("ship", None))
             # backpressure: if the device path is slower than ingestion,
             # wait for the ship threads to work the C++ queues down
+            # (re-poking them each beat: a ship thread that held a launch
+            # for coalescing has no other wake-up once tokens stop)
             with profile.span("backpressure_wait"):
+                beats = 0
                 while (self._ship_exc is None
                        and max(self._lib.wf_launch_pending(h)
                                for h in self._hs) > self._max_pending):
                     time.sleep(0.001)
+                    beats += 1
+                    if beats % 20 == 0:
+                        for q in self._ship_qs:
+                            q.put(("ship", None))
             drained = self._drain_out_q()
             if self._ship_exc is not None:
                 self._raise_ship_exc(drained)
@@ -295,7 +314,7 @@ class NativeResidentCore:
             return self._harvest(out)
         harvested = []
         for t in range(self.shards):
-            while self._ship_launch(t):
+            while self._ship_launch(t, force=True):
                 pass
             harvested.extend(self.executors[t].drain())
         return self._harvest(harvested)
@@ -306,9 +325,24 @@ class NativeResidentCore:
 
     # ------------------------------------------------------- launch plumbing
 
-    def _ship_launch(self, shard: int = 0) -> bool:
+    def _ship_launch(self, shard: int = 0, force: bool = False) -> bool:
         lib = self._lib
         handle = self._hs[shard]
+        ex_ = self.executors[shard]
+        pending = lib.wf_launch_pending(handle)
+        if pending == 0:
+            return False
+        coalesce = not os.environ.get("WF_NO_COALESCE")
+        if coalesce and not force and pending <= self._max_pending:
+            # (beyond _max_pending the hold is skipped: the producer's
+            # backpressure loop waits on this queue, so holding there
+            # would livelock — and the memory bound outranks RTT savings)
+            if ex_.unready_count() >= self._dispatch_window:
+                # wire saturated: hold this launch so the queue deepens and
+                # the next ship fuses the backlog into one dispatch
+                return False
+        if coalesce and pending > 1:
+            lib.wf_launch_coalesce(handle, self._coalesce_cells, 8)
         K = ctypes.c_longlong()
         R = ctypes.c_longlong()
         B = ctypes.c_longlong()
