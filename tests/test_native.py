@@ -312,3 +312,84 @@ def test_native_coalesce_across_value_widths():
     nat = make_native(spec, Reducer("sum"), batch_len=1 << 20,
                       flush_rows=96, overlap=False)
     assert_equal_results(host, run_core(nat, batches))
+
+
+def test_native_periodic_fast_path_equals_general():
+    """The periodic-chunk bulk path must be row-identical to the general
+    loop: the same logical stream arranged periodically (fast path
+    engages) and pair-shuffled (detection bails -> general loop) gives
+    identical sorted results."""
+    spec = WindowSpec(16, 4, WinType.CB)
+    n_keys, per_key = 8, 600
+    rng = np.random.default_rng(31)
+    vals = rng.integers(-50, 100, size=per_key * n_keys).astype(np.int64)
+
+    def stream(shuffled):
+        batches = []
+        for lo in range(0, per_key, 97):
+            m = min(97, per_key - lo)
+            keys = np.tile(np.arange(n_keys), m)
+            ids = np.repeat(np.arange(lo, lo + m), n_keys)
+            v = vals[lo * n_keys:(lo + m) * n_keys]
+            if shuffled:
+                # swap adjacent different-key rows: periodicity breaks,
+                # per-key order survives
+                perm = np.arange(m * n_keys)
+                even = perm[: (m * n_keys) // 2 * 2]
+                perm[: len(even)] = even.reshape(-1, 2)[:, ::-1].ravel()
+                keys, ids, v = keys[perm], ids[perm], v[perm]
+            batches.append(batch_from_columns(
+                SCHEMA, key=keys, id=ids, ts=ids * 7, value=v))
+        return batches
+
+    a = run_core(make_native(spec, Reducer("sum"), batch_len=64,
+                             flush_rows=500), stream(False))
+    b = run_core(make_native(spec, Reducer("sum"), batch_len=64,
+                             flush_rows=500), stream(True))
+    host = run_core(WinSeqCore(spec, Reducer("sum")), stream(False))
+    assert_equal_results(host, a)
+    assert_equal_results(host, b)
+
+
+def test_native_periodic_fast_path_cross_chunk_gap():
+    """A periodic chunk whose per-key ids jump past the previous chunk's
+    (id gap across chunks) must produce the same empty-window firings as
+    the general loop."""
+    spec = WindowSpec(8, 8, WinType.CB)
+    n_keys = 4
+
+    def chunk(lo, m):
+        return batch_from_columns(
+            SCHEMA, key=np.tile(np.arange(n_keys), m),
+            id=np.repeat(np.arange(lo, lo + m), n_keys),
+            ts=np.repeat(np.arange(lo, lo + m), n_keys),
+            value=np.arange(m * n_keys, dtype=np.int64))
+
+    batches = [chunk(0, 20), chunk(50, 20), chunk(200, 20)]
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    nat = make_native(spec, Reducer("sum"), batch_len=32, flush_rows=64)
+    assert_equal_results(host, run_core(nat, batches))
+
+
+def test_native_rebase_reships_wide_values_on_wide_wire():
+    """A ring rebase re-ships ALL live rows; the wire dtype must cover the
+    re-shipped (previously shipped) values, not just the pending ones —
+    narrow-wire truncation here silently corrupts aggregates."""
+    spec = WindowSpec(16, 4, WinType.CB)
+    # key 0 ships 8 rows of 3000 (int16 wire) first ...
+    b1 = batch_from_columns(SCHEMA, key=np.zeros(8), id=np.arange(8),
+                            ts=np.arange(8),
+                            value=np.full(8, 3000, dtype=np.int64))
+    # ... then 19 NEW keys with tiny values force KP growth -> rebase;
+    # the rebase launch re-ships key 0's live 3000s
+    rows = []
+    for i in range(8, 20):
+        for k in range(20):
+            rows.append((k, i))
+    keys = np.array([r[0] for r in rows])
+    ids = np.array([r[1] for r in rows])
+    b2 = batch_from_columns(SCHEMA, key=keys, id=ids, ts=ids,
+                            value=np.ones(len(rows), dtype=np.int64))
+    host = run_core(WinSeqCore(spec, Reducer("sum")), [b1, b2])
+    nat = make_native(spec, Reducer("sum"), batch_len=1 << 20, flush_rows=8)
+    assert_equal_results(host, run_core(nat, [b1, b2]))

@@ -328,16 +328,19 @@ class NativeResidentCore:
     def _ship_launch(self, shard: int = 0, force: bool = False) -> bool:
         lib = self._lib
         handle = self._hs[shard]
-        ex_ = self.executors[shard]
+        ex = self.executors[shard]
         pending = lib.wf_launch_pending(handle)
         if pending == 0:
             return False
         coalesce = not os.environ.get("WF_NO_COALESCE")
-        if coalesce and not force and pending <= self._max_pending:
+        if (coalesce and not force and pending <= self._max_pending
+                and self.max_delay_s is None):
             # (beyond _max_pending the hold is skipped: the producer's
             # backpressure loop waits on this queue, so holding there
-            # would livelock — and the memory bound outranks RTT savings)
-            if ex_.unready_count() >= self._dispatch_window:
+            # would livelock — and the memory bound outranks RTT savings.
+            # A latency-bounded core never holds: a launch parked behind
+            # a stalled wire would blow the max_delay budget by design.)
+            if ex.unready_count() >= self._dispatch_window:
                 # wire saturated: hold this launch so the queue deepens and
                 # the next ship fuses the backlog into one dispatch
                 return False
@@ -369,7 +372,6 @@ class NativeResidentCore:
         hlen = np.empty(max(B, 1), dtype=np.int64)
         p32 = ctypes.POINTER(ctypes.c_int32)
         p64 = ctypes.POINTER(ctypes.c_longlong)
-        ex = self.executors[shard]
         regular = False
         cmax = ctypes.c_longlong()
         if (self.reducer.op == "sum"
