@@ -259,13 +259,17 @@ def test_multi_stat_rejects_count_only():
                           MultiReducer(("count", None, "n")))
 
 
-def test_multi_stat_rejects_two_fields():
-    with pytest.raises(ValueError, match="resident"):
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            make_core_for(WindowSpec(4, 2, WinType.CB),
-                          MultiReducer(("sum", "value", "s"),
-                                       ("max", "ts", "m")))
+def test_multi_stat_two_fields_takes_multifield_rings():
+    """Stats over different fields get one resident ring each (was a
+    rejection before MultiFieldResidentExecutor existed)."""
+    from windflow_tpu.ops.resident import MultiFieldResidentExecutor
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(4, 2, WinType.CB),
+                             MultiReducer(("sum", "value", "s"),
+                                          ("max", "ts", "m")))
+    assert isinstance(core.executor, MultiFieldResidentExecutor)
+    assert core.executor.fields == ("value", "ts")
 
 
 # ---------------------------------------------------------- latency bound
@@ -291,3 +295,159 @@ def test_max_delay_flushes_partial_batches():
         n += len(core.process(np.zeros(0, dtype=b1.dtype)))
     assert n > 0, "max_delay did not ship the pending windows"
     core.flush()
+
+
+# ---------------------------------------------------------------- multi-field
+
+SCHEMA2 = Schema(a=np.int64, b=np.int64)
+
+
+def two_field_stream(n_keys=4, per_key=400, chunk=61, seed=3):
+    rng = np.random.default_rng(seed)
+    batches = []
+    for lo in range(0, per_key, chunk):
+        m = min(chunk, per_key - lo)
+        ids = np.repeat(np.arange(lo, lo + m), n_keys)
+        keys = np.tile(np.arange(n_keys), m)
+        batches.append(batch_from_columns(
+            SCHEMA2, key=keys, id=ids, ts=ids,
+            a=rng.integers(-50, 100, m * n_keys),
+            b=rng.integers(0, 2000, m * n_keys)))
+    return batches
+
+
+def test_resident_multifield_multireducer_matches_host():
+    """sum(a) + max(b) + count over per-field resident rings equals the
+    host core row for row (the reference's device functors read whole POD
+    tuples, win_seq_gpu.hpp:54-67 — here each field ships once into its
+    own HBM ring)."""
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.ops.resident import MultiFieldResidentExecutor
+
+    def mk():
+        return MultiReducer(("sum", "a", "sa"), ("max", "b", "mb"),
+                            ("count", None, "n"))
+
+    spec = WindowSpec(16, 4, WinType.CB)
+    batches = two_field_stream()
+    host = run_core(WinSeqCore(spec, mk()), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mk(), batch_len=32, flush_rows=100)
+    assert isinstance(core, ResidentWinSeqCore)
+    assert isinstance(core.executor, MultiFieldResidentExecutor)
+    got = run_core(core, batches)
+    assert len(host) == len(got)
+    for f in ("key", "id", "ts", "sa", "mb", "n"):
+        np.testing.assert_array_equal(host[f], got[f])
+
+
+def test_resident_multifield_tiny_flush_rebases():
+    """Multi-field rings rebuild correctly across ring rebases."""
+    from windflow_tpu.ops.functions import MultiReducer
+
+    def mk():
+        return MultiReducer(("min", "a", "mn"), ("sum", "b", "sb"))
+
+    spec = WindowSpec(12, 6, WinType.CB)
+    batches = two_field_stream(n_keys=3, per_key=300, chunk=23, seed=9)
+    host = run_core(WinSeqCore(spec, mk()), batches)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mk(), batch_len=8, flush_rows=24)
+    got = run_core(core, batches)
+    for f in ("key", "id", "ts", "mn", "sb"):
+        np.testing.assert_array_equal(host[f], got[f])
+
+
+def test_resident_jax_fn_matches_restaging_and_host():
+    """An arbitrary JAX window fn (sum of a*b per window) over resident
+    rings (use_resident=True) equals the restaging executor and the host
+    oracle."""
+    import jax.numpy as jnp
+    from windflow_tpu.ops.functions import FnWindowFunction
+    from windflow_tpu.patterns.win_seq_tpu import JaxWindowFunction
+
+    def dev_fn(keys, gwids, cols, mask):
+        prod = jnp.where(mask, cols["a"] * cols["b"], 0)
+        return jnp.sum(prod, axis=1)
+
+    def host_fn(key, gwid, rows):
+        return (int((rows["a"] * rows["b"]).sum()),)
+
+    spec = WindowSpec(10, 5, WinType.CB)
+    batches = two_field_stream(n_keys=3, per_key=250, chunk=41, seed=5,
+                               )
+    host = run_core(
+        WinSeqCore(spec, FnWindowFunction(host_fn, {"value": np.int64})),
+        batches)
+
+    def jf():
+        return JaxWindowFunction(dev_fn, fields=("a", "b"),
+                                 result_fields={"value": np.int64})
+
+    resident = run_core(
+        make_core_for(spec, jf(), batch_len=32, flush_rows=90,
+                      use_resident=True), batches)
+    restaged = run_core(
+        make_core_for(spec, jf(), batch_len=32), batches)
+    assert_equal_results(host, resident)
+    assert_equal_results(host, restaged)
+
+
+def test_resident_jax_fn_multi_output():
+    """A JAX fn returning several result columns maps them to its declared
+    result_fields in order."""
+    import jax.numpy as jnp
+    from windflow_tpu.patterns.win_seq_tpu import JaxWindowFunction
+    from windflow_tpu.ops.functions import FnWindowFunction
+
+    def dev_fn(keys, gwids, cols, mask):
+        a = jnp.where(mask, cols["a"], 0)
+        return jnp.sum(a, axis=1), jnp.max(jnp.where(mask, cols["a"], -1 << 30), axis=1)
+
+    def host_fn(key, gwid, rows):
+        return (int(rows["a"].sum()),
+                int(rows["a"].max()) if len(rows) else -(1 << 30))
+
+    spec = WindowSpec(8, 8, WinType.CB)
+    batches = two_field_stream(n_keys=2, per_key=200, chunk=33, seed=7)
+    host = run_core(WinSeqCore(spec, FnWindowFunction(
+        host_fn, {"s": np.int64, "m": np.int64})), batches)
+    jf = JaxWindowFunction(dev_fn, fields=("a",),
+                           result_fields={"s": np.int64, "m": np.int64})
+    got = run_core(make_core_for(spec, jf, batch_len=16, flush_rows=64,
+                                 use_resident=True), batches)
+    for f in ("key", "id", "ts", "s", "m"):
+        np.testing.assert_array_equal(host[f], got[f])
+
+
+def test_resident_jax_fn_rejects_int64_ring_without_x64():
+    """Declared 64-bit ring dtypes need jax x64 (otherwise jax silently
+    truncates the ring to 32 bits)."""
+    import jax
+    from windflow_tpu.patterns.win_seq_tpu import JaxWindowFunction
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled in this process")
+    jf = JaxWindowFunction(lambda k, g, c, m: c["a"].sum(axis=1),
+                           fields=("a",), result_fields={"v": np.int64},
+                           field_dtypes={"a": np.int64})
+    with pytest.raises(ValueError, match="x64"):
+        make_core_for(WindowSpec(4, 2, WinType.CB), jf, use_resident=True)
+
+
+def test_resident_float_column_into_int_ring_rejected():
+    """A float column shipped into a default int32 ring must raise, not
+    silently truncate (declare field_dtypes for float data)."""
+    from windflow_tpu.patterns.win_seq_tpu import JaxWindowFunction
+    schema = Schema(x=np.float64)
+    b = batch_from_columns(schema, key=np.zeros(8), id=np.arange(8),
+                           ts=np.arange(8),
+                           x=np.full(8, 0.5, dtype=np.float64))
+    jf = JaxWindowFunction(lambda k, g, c, m: c["x"].sum(axis=1),
+                           fields=("x",), result_fields={"v": np.float64})
+    core = make_core_for(WindowSpec(4, 4, WinType.CB), jf,
+                         batch_len=4, flush_rows=4, use_resident=True)
+    with pytest.raises(ValueError, match="float column"):
+        core.process(b)
+        core.flush()

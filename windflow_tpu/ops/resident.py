@@ -370,6 +370,181 @@ class ResidentWindowExecutor:
         return ready
 
 
+def _make_multi_step(key, jax_fn):
+    """Fused multi-field append + eval: one ring per field, reducer stats
+    evaluate over their field's ring, and an optional batched JAX window
+    function (JaxWindowFunction) reads (B, pad) gathers of every field —
+    the device-resident form of the reference's arbitrary device functor
+    over whole POD tuples (win_seq_gpu.hpp:54-67): every column crosses
+    the wire once, the functor reads HBM."""
+    (fields, stats, _fnid, cap, Rb, Bb, KP, wires, accs, pad) = key
+    acc_dts = tuple(np.dtype(a) for a in accs)
+    fidx = {f: i for i, f in enumerate(fields)}
+
+    def step(rings, blks, offs, wrows, wstarts, wlens, wkeys, wgwids):
+        rings = tuple(_ring_append(r, b, offs, dt)
+                      for r, b, dt in zip(rings, blks, acc_dts))
+        outs = []
+        for op, f in stats:
+            outs.append(_ring_eval(op, cap, pad, acc_dts[fidx[f]],
+                                   rings[fidx[f]], wrows, wstarts, wlens))
+        if jax_fn is not None:
+            idx = jnp.minimum(
+                wstarts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
+                cap - 1)
+            mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < wlens[:, None]
+            cols = {}
+            for f in jax_fn.fields:
+                vals = rings[fidx[f]][wrows[:, None], idx]
+                cols[f] = jnp.where(mask, vals, 0)
+            res = jax_fn.fn(wkeys, wgwids, cols, mask)
+            outs.extend(res if isinstance(res, tuple) else (res,))
+        return rings, tuple(outs)
+
+    return jax.jit(step)
+
+
+class MultiFieldResidentExecutor(ResidentWindowExecutor):
+    """Resident launch queue with one ring PER FIELD: multi-field
+    reducer stats (e.g. sum(a) + max(b)) and arbitrary batched JAX window
+    functions evaluate over device-resident archives — rows cross the
+    wire once per field instead of once per fire (the restaging path,
+    ops/device.py, which mirrors the reference's per-batch H2D memcpy).
+
+    ``stats``: tuple of (op, field) reducer evaluations; ``jax_fn``: an
+    optional JaxWindowFunction whose ``fn(keys, gwids, cols, mask)`` runs
+    over (B, pad) gathers of its fields.  ``acc_dtypes`` maps each field
+    to its ring dtype."""
+
+    def __init__(self, fields, stats=(), jax_fn=None, acc_dtypes=None,
+                 device=None, depth: int = 8):
+        self.fields = tuple(fields)
+        if not self.fields:
+            raise ValueError("need at least one ring field")
+        self.stats = tuple(stats)
+        self.jax_fn = jax_fn
+        for op, f in self.stats:
+            if op not in _REDUCE_OPS:
+                raise ValueError(f"unsupported resident op {op!r}")
+            if f not in self.fields:
+                raise ValueError(f"stat field {f!r} not in ring fields")
+        if jax_fn is not None:
+            for f in jax_fn.fields:
+                if f not in self.fields:
+                    raise ValueError(f"fn field {f!r} not in ring fields")
+        if not self.stats and jax_fn is None:
+            raise ValueError("nothing to evaluate")
+        self.acc_dtypes = {f: np.dtype(acc_dtypes[f]) for f in self.fields}
+        self.device = device or jax.devices()[0]
+        self.depth = depth
+        self.cap = 0
+        self.KP = 0
+        self._rings = None
+        self._inflight = deque()
+        self._ready = []
+        self._step_cache = {}   # per-executor cache for fn-bound steps
+
+    # single-field plumbing from the base class that does not apply
+    op = property(lambda self: tuple(op for op, _f in self.stats))
+    single = False
+
+    def reset(self, n_keys: int, cap: int):
+        self.KP = _bucket(max(n_keys, 1))
+        self.cap = _bucket(max(cap, 16))
+        self._rings = None
+
+    def _rings_arr(self):
+        if self._rings is None:
+            self._rings = tuple(
+                jax.device_put(
+                    jnp.zeros((self.KP, self.cap),
+                              dtype=self.acc_dtypes[f]), self.device)
+                for f in self.fields)
+        return self._rings
+
+    def narrow_for(self, field, vals: np.ndarray) -> np.dtype:
+        """Per-field wire narrowing (same ladder as the base class but
+        bounded by that field's ring dtype)."""
+        acc = self.acc_dtypes[field]
+        wide = acc.itemsize >= 8
+        if len(vals) and vals.dtype.kind == "f" and acc.kind != "f":
+            raise ValueError(
+                f"float column {field!r} headed into a {acc} ring would "
+                "silently truncate — declare a float ring dtype "
+                f"(JaxWindowFunction(field_dtypes={{{field!r}: "
+                "np.float32}}))")
+        if acc.kind == "f":
+            return np.dtype(np.float64 if wide else np.float32)
+        if not len(vals):
+            return np.dtype(np.int8)
+        lo, hi = int(vals.min()), int(vals.max())
+        ladder = (np.int8, np.int16, np.int32, np.int64) if wide else \
+                 (np.int8, np.int16, np.int32)
+        for dt in ladder:
+            info = np.iinfo(dt)
+            if info.min <= lo and hi <= info.max:
+                return np.dtype(dt)
+        return np.dtype(ladder[-1])
+
+    def launch(self, meta, blks: dict, offs: np.ndarray,
+               wrows: np.ndarray, wstarts: np.ndarray, wlens: np.ndarray,
+               wkeys: np.ndarray = None, wgwids: np.ndarray = None):
+        """One fused dispatch: per-field rectangles `blks[f]` (K, R) append
+        at `offs`, then every stat / the JAX fn evaluates the described
+        windows.  `wkeys`/`wgwids` are required when a JAX fn is bound."""
+        K, R = next(iter(blks.values())).shape
+        if K > self.KP:
+            raise ValueError("rectangle exceeds ring rows; reset() first")
+        B = len(wstarts)
+        Rb = _bucket(max(R, 1))
+        Bb = _bucket(max(B, 1))
+        _check_ring_overflow(offs, Rb, self.cap)
+        pad = (_bucket(int(wlens.max()) if B else 1)
+               if (self.jax_fn is not None
+                   or any(op != "sum" for op, _f in self.stats)) else 0)
+        wires = tuple(blks[f].dtype.str for f in self.fields)
+        key = (self.fields, self.stats, None, self.cap, Rb, Bb,
+               self.KP, wires,
+               tuple(self.acc_dtypes[f].str for f in self.fields), pad)
+        # fn-bound steps cache per executor (the jitted closure pins the
+        # fn; a process-wide cache keyed on fn identity would pin every
+        # instance + compiled executable forever); stat-only steps share
+        # the process-wide cache like the base class
+        cache = _STEP_CACHE if self.jax_fn is None else self._step_cache
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _make_multi_step(key, self.jax_fn)
+        with profile.span("device_put"):
+            blkps = tuple(
+                (blks[f] if blks[f].shape == (self.KP, Rb)
+                 else _pad2(blks[f], self.KP, Rb)) for f in self.fields)
+            args = jax.device_put(
+                (blkps, _pad1(offs, self.KP), _pad1(wrows, Bb),
+                 _pad1(wstarts, Bb), _pad1(wlens, Bb),
+                 _pad1(wkeys if wkeys is not None else np.zeros(0), Bb,
+                       dtype=np.int64),
+                 _pad1(wgwids if wgwids is not None else np.zeros(0), Bb,
+                       dtype=np.int64)),
+                self.device)
+        for f in self.fields:
+            profile.add("bytes_shipped", blks[f].nbytes)
+            profile.add("rows_shipped", blks[f].size)
+        profile.add("windows", B)
+        with profile.span("dispatch"):
+            self._rings, out = fn(self._rings_arr(), *args)
+            for o in out:
+                getattr(o, "copy_to_host_async", lambda: None)()
+        self._inflight.append((meta, B, out))
+        while len(self._inflight) > self.depth:
+            self._harvest_one()
+
+    def _harvest_one(self):
+        meta, B, out = self._inflight.popleft()
+        with profile.span("harvest_wait"):
+            arrs = tuple(np.asarray(o)[:B] for o in out)
+        self._ready.append((meta, arrs))
+
+
 class MeshResidentExecutor(ResidentWindowExecutor):
     """Resident ring sharded ``P(kf, None)`` over a ``jax.sharding.Mesh``:
     dense-key ring rows are block-distributed over the mesh's key-group

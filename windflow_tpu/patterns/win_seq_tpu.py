@@ -62,10 +62,16 @@ class JaxWindowFunction:
     ``F(key, gwid, data, res, size, scratch)`` (win_seq_gpu.hpp:54-67,
     deduced at meta_utils.hpp:173-180)."""
 
-    def __init__(self, fn, fields=("value",), result_fields=None):
+    def __init__(self, fn, fields=("value",), result_fields=None,
+                 field_dtypes=None):
         self.fn = fn
         self.fields = tuple(fields)
         self.result_fields = dict(result_fields or {"value": np.int64})
+        #: ring dtype per input field on the resident path (default int32;
+        #: float columns need an explicit float32 here — the ring is typed
+        #: at allocation, unlike the restaging path which stages whatever
+        #: dtype each launch carries)
+        self.field_dtypes = dict(field_dtypes or {})
 
 
 def _host_standin(winfunc):
@@ -282,50 +288,110 @@ class ResidentWinSeqCore(WinSeqCore):
                  compute_dtype=None, worker_index: int = 0, mesh=None,
                  max_delay_ms=None):
         from ..ops.resident import (MeshResidentExecutor,
+                                    MultiFieldResidentExecutor,
                                     ResidentWindowExecutor)
-        if isinstance(reducer, MultiReducer):
-            # multi-stat: every non-count stat evaluates over ONE shipped
-            # column in one fused dispatch; counts come free from lens
+        self._jax_fn = None
+        if isinstance(reducer, JaxWindowFunction):
+            # arbitrary batched JAX window fn over device-resident rings —
+            # one ring per input field (win_seq_gpu.hpp:54-67's arbitrary
+            # functor over whole POD tuples, without per-fire restaging)
+            self._device_parts = []
+            self._count_parts = []
+            self._jax_fn = reducer
+            field = None
+        elif isinstance(reducer, MultiReducer):
+            # multi-stat: every non-count stat evaluates over its field's
+            # resident ring in one fused dispatch; counts come free
             self._device_parts = reducer.device_parts
             self._count_parts = reducer.count_parts
-            field = reducer.resident_field()
-            if not self._device_parts or field is None:
+            field = reducer.resident_field()  # None => multi-field rings
+            if not self._device_parts:
                 raise ValueError(
-                    "resident MultiReducer needs >=1 non-count stat, all "
-                    "over one field (use Reducer('count') for pure counts)")
+                    "resident MultiReducer needs >=1 non-count stat "
+                    "(use Reducer('count') for pure counts)")
         elif isinstance(reducer, Reducer):
             self._device_parts = [reducer]
             self._count_parts = []
             field = reducer.field
         else:
-            raise TypeError("resident device path needs a builtin Reducer "
-                            "or MultiReducer")
-        super().__init__(spec, reducer, config=config, role=role,
+            raise TypeError("resident device path needs a builtin Reducer, "
+                            "MultiReducer, or JaxWindowFunction")
+        host_fn = _host_standin(reducer)
+        super().__init__(spec, host_fn, config=config, role=role,
                          map_indexes=map_indexes,
                          result_ts_slide=result_ts_slide)
         self.reducer = reducer
         self.field = field
-        accs = [select_acc_dtype(p, compute_dtype)
-                for p in self._device_parts]
-        kinds = {d.kind for d in accs}
-        if len(kinds) > 1:
-            # one shared ring, one accumulate dtype: a float ring would
-            # silently round sibling integer sums (float32 spacing > 1
-            # above 2^24) — refuse instead
-            raise ValueError(
-                "multi-stat parts disagree on accumulate kind "
-                f"({sorted(str(a) for a in accs)}): split the stats or "
-                "pass an explicit compute_dtype")
-        acc = max(accs, key=lambda d: d.itemsize)
-        ops = tuple(p.op for p in self._device_parts)
-        op_arg = ops[0] if len(ops) == 1 else ops
-        if mesh is not None:
-            self.executor = MeshResidentExecutor(op_arg, mesh,
-                                                 depth=depth, acc_dtype=acc)
+        if self._jax_fn is not None:
+            self._ship_fields = tuple(self._jax_fn.fields)
+        elif field is not None:
+            self._ship_fields = (field,)
         else:
-            self.executor = ResidentWindowExecutor(
-                op_arg, device=resolve_worker_device(device, worker_index),
-                depth=depth, acc_dtype=acc)
+            self._ship_fields = tuple(dict.fromkeys(
+                p.field for p in self._device_parts))
+        multi = field is None
+        if multi:
+            if mesh is not None:
+                raise ValueError(
+                    "mesh execution supports single-field reducers only "
+                    "(shard the multi-field pattern over farm workers "
+                    "instead)")
+            # per-field ring dtypes: reducer parts pick theirs via
+            # select_acc_dtype; fn-only fields use the fn's declared
+            # field_dtypes (default int32)
+            acc_by_field = {}
+            for p in self._device_parts:
+                a = select_acc_dtype(p, compute_dtype)
+                prev = acc_by_field.get(p.field)
+                if prev is not None and prev.kind != a.kind:
+                    raise ValueError(
+                        f"stats over field {p.field!r} disagree on "
+                        f"accumulate kind ({prev} vs {a})")
+                if prev is None or a.itemsize > prev.itemsize:
+                    acc_by_field[p.field] = a
+            if self._jax_fn is not None:
+                declared = getattr(self._jax_fn, "field_dtypes", None) or {}
+                for f in self._ship_fields:
+                    dt = np.dtype(declared.get(f, np.int32))
+                    if dt.itemsize >= 8:
+                        # same guard select_acc_dtype applies: without x64
+                        # jax silently canonicalizes the ring to 32 bits
+                        import jax
+                        if not jax.config.jax_enable_x64:
+                            raise ValueError(
+                                f"field_dtypes[{f!r}]={dt} needs jax x64 "
+                                "enabled (jax.config.update("
+                                "'jax_enable_x64', True))")
+                    acc_by_field.setdefault(f, dt)
+            self.executor = MultiFieldResidentExecutor(
+                self._ship_fields,
+                stats=tuple((p.op, p.field) for p in self._device_parts),
+                jax_fn=self._jax_fn, acc_dtypes=acc_by_field,
+                device=resolve_worker_device(device, worker_index),
+                depth=depth)
+        else:
+            accs = [select_acc_dtype(p, compute_dtype)
+                    for p in self._device_parts]
+            kinds = {d.kind for d in accs}
+            if len(kinds) > 1:
+                # one shared ring, one accumulate dtype: a float ring would
+                # silently round sibling integer sums (float32 spacing > 1
+                # above 2^24) — refuse instead
+                raise ValueError(
+                    "multi-stat parts disagree on accumulate kind "
+                    f"({sorted(str(a) for a in accs)}): split the stats or "
+                    "pass an explicit compute_dtype")
+            acc = max(accs, key=lambda d: d.itemsize)
+            ops = tuple(p.op for p in self._device_parts)
+            op_arg = ops[0] if len(ops) == 1 else ops
+            if mesh is not None:
+                self.executor = MeshResidentExecutor(
+                    op_arg, mesh, depth=depth, acc_dtype=acc)
+            else:
+                self.executor = ResidentWindowExecutor(
+                    op_arg,
+                    device=resolve_worker_device(device, worker_index),
+                    depth=depth, acc_dtype=acc)
         self.batch_len = batch_len
         self.flush_rows = flush_rows
         # latency bound: ship pending windows/rows after this many ms even
@@ -338,9 +404,10 @@ class ResidentWinSeqCore(WinSeqCore):
         self._appended = {}   # key -> rows ever archived (abs row domain)
         self._launched = {}   # key -> rows already shipped to the ring
         self._base = {}       # key -> abs row index of ring column 0
-        self._pend_vals = {}  # key -> [value arrays not yet shipped]
+        #: field -> key -> [column arrays not yet shipped]
+        self._pend_cols = {f: {} for f in self._ship_fields}
         self._pend_rows = 0
-        self._wdesc = []      # (key, abs_lo array, len array)
+        self._wdesc = []      # (key, abs_lo array, len array, gwids)
         self._hdr = []        # (key, ids, ts, lens) per fire
         self._n_wins = 0
         self._purge_pos = {}  # key -> purge threshold deferred to flush
@@ -349,8 +416,9 @@ class ResidentWinSeqCore(WinSeqCore):
 
     def _on_append(self, key, st, rows):
         self._rowmap.setdefault(key, len(self._rowmap))
-        self._pend_vals.setdefault(key, []).append(
-            np.asarray(rows[self.field]))
+        for f in self._ship_fields:
+            self._pend_cols[f].setdefault(key, []).append(
+                np.asarray(rows[f]))
         self._appended[key] = self._appended.get(key, 0) + len(rows)
         self._pend_rows += len(rows)
         if self._pend_rows >= self.flush_rows:
@@ -369,7 +437,8 @@ class ResidentWinSeqCore(WinSeqCore):
         hi = (np.full(len(lwids), len(p), dtype=np.int64) if eos
               else np.searchsorted(p, ends_abs, side="left"))
         live_start = self._appended.get(key, 0) - len(p)
-        self._wdesc.append((key, lo + live_start, (hi - lo).astype(np.int64)))
+        self._wdesc.append((key, lo + live_start, (hi - lo).astype(np.int64),
+                            gwids))
         self._hdr.append((key, ids, ts, (hi - lo).astype(np.int64)))
         self._n_wins += len(lwids)
         if not eos and len(lwids):
@@ -416,48 +485,71 @@ class ResidentWinSeqCore(WinSeqCore):
             per_key_slack = max(self.flush_rows // max(K, 1), 64)
             ex.reset(K, _bucket(2 * maxlive + 2 * per_key_slack))
             R = maxlive
-            srcs = {key: ([np.asarray(self._keys[key].archive.rows[self.field])]
-                          if key in self._keys else [])
-                    for key in rowmap}
+            srcs = {f: {key: ([np.asarray(self._keys[key].archive.rows[f])]
+                              if key in self._keys else [])
+                        for key in rowmap}
+                    for f in self._ship_fields}
             for key in rowmap:
                 self._base[key] = self._appended.get(key, 0) - counts[key]
                 self._launched[key] = self._base[key]
             offs = np.zeros(ex.KP, dtype=np.int64)
         else:
-            srcs = self._pend_vals
+            srcs = self._pend_cols
             counts = {key: self._appended.get(key, 0)
                       - self._launched.get(key, 0) for key in rowmap}
             R = max(counts.values(), default=0)
             offs = np.zeros(ex.KP, dtype=np.int64)
             for key, r in rowmap.items():
                 offs[r] = self._launched.get(key, 0) - self._base.get(key, 0)
-        # --- build the rectangle in the narrowest wire dtype ---
-        arrays = [a for key in rowmap for a in srcs.get(key, []) if len(a)]
-        if arrays:
-            lo = min(a.min() for a in arrays)
-            hi = max(a.max() for a in arrays)
-            probe = np.array([lo, hi], dtype=arrays[0].dtype)
-        else:
-            probe = np.zeros(0, dtype=np.int64)
-        wire = ex.narrow(probe)
-        blk = np.zeros((K, max(R, 1)), dtype=wire)
-        for key, r in rowmap.items():
-            c = 0
-            for a in srcs.get(key, []):
-                blk[r, c:c + len(a)] = a
-                c += len(a)
+        # --- per-field rectangles in the narrowest wire dtype ---
+        blks = {}
+        for f in self._ship_fields:
+            fsrcs = srcs[f]
+            arrays = [a for key in rowmap for a in fsrcs.get(key, [])
+                      if len(a)]
+            if arrays:
+                lo = min(a.min() for a in arrays)
+                hi = max(a.max() for a in arrays)
+                probe = np.array([lo, hi], dtype=arrays[0].dtype)
+            else:
+                probe = np.zeros(0, dtype=np.int64)
+            wire = (ex.narrow_for(f, probe) if hasattr(ex, "narrow_for")
+                    else ex.narrow(probe))
+            blk = np.zeros((K, max(R, 1)), dtype=wire)
+            for key, r in rowmap.items():
+                c = 0
+                for a in fsrcs.get(key, []):
+                    blk[r, c:c + len(a)] = a
+                    c += len(a)
+            blks[f] = blk
         # --- window descriptors in ring coordinates ---
         if self._wdesc:
             wrows = np.concatenate([
                 np.full(len(lens), rowmap[key], dtype=np.int64)
-                for key, _, lens in self._wdesc])
+                for key, _, lens, _g in self._wdesc])
             wstarts = np.concatenate([
                 abs_lo - self._base.get(key, 0)
-                for key, abs_lo, _ in self._wdesc])
-            wlens = np.concatenate([lens for _, _, lens in self._wdesc])
+                for key, abs_lo, _l, _g in self._wdesc])
+            wlens = np.concatenate([lens for _k, _a, lens, _g in self._wdesc])
         else:
             wrows = wstarts = wlens = np.zeros(0, dtype=np.int64)
-        ex.launch(self._hdr, blk, offs[:K], wrows, wstarts, wlens)
+        from ..ops.resident import MultiFieldResidentExecutor
+        if isinstance(ex, MultiFieldResidentExecutor):
+            # multi-field executor: ships every ring's rectangle + the
+            # (keys, gwids) header columns the JAX fn contract receives
+            if self._jax_fn is not None and self._wdesc:
+                wkeys = np.concatenate([
+                    np.full(len(lens), key, dtype=np.int64)
+                    for key, _a, lens, _g in self._wdesc])
+                wgwids = np.concatenate(
+                    [g for _k, _a, _l, g in self._wdesc]).astype(np.int64)
+            else:
+                wkeys = wgwids = np.zeros(0, dtype=np.int64)
+            ex.launch(self._hdr, blks, offs[:K], wrows, wstarts, wlens,
+                      wkeys=wkeys, wgwids=wgwids)
+        else:
+            ex.launch(self._hdr, blks[self.field], offs[:K], wrows,
+                      wstarts, wlens)
         # --- advance cursors, apply deferred purges ---
         for key in rowmap:
             self._launched[key] = self._appended.get(key, 0)
@@ -465,7 +557,7 @@ class ResidentWinSeqCore(WinSeqCore):
             st = self._keys.get(key)
             if st is not None:
                 st.archive.purge_below(pos)
-        self._pend_vals = {}
+        self._pend_cols = {f: {} for f in self._ship_fields}
         self._pend_rows = 0
         self._wdesc, self._hdr, self._n_wins = [], [], 0
         self._purge_pos = {}
@@ -480,15 +572,22 @@ class ResidentWinSeqCore(WinSeqCore):
 
     def _build_results(self, harvested):
         outs = []
+        fn_fields = (tuple(self._jax_fn.result_fields.items())
+                     if self._jax_fn is not None else ())
         for hdr, out in harvested:
             stat_arrs = out if isinstance(out, tuple) else (out,)
             off = 0
             for key, ids, ts, lens in hdr:
                 n = len(ids)
                 payload = {}
-                for p, arr in zip(self._device_parts, stat_arrs):
+                i = 0
+                for p in self._device_parts:
                     payload[p.out_field] = finalize_window_values(
-                        p, arr[off:off + n], lens)
+                        p, stat_arrs[i][off:off + n], lens)
+                    i += 1
+                for name, dt in fn_fields:
+                    payload[name] = stat_arrs[i][off:off + n].astype(dt)
+                    i += 1
                 for p in self._count_parts:
                     payload[p.out_field] = lens.astype(p.dtype)
                 outs.append(self._make_results(key, ids, ts, payload))
@@ -531,10 +630,11 @@ _RESIDENT_OPS = ("sum", "min", "max", "prod")
 
 def _multi_resident_ok(winfunc: MultiReducer, use_pallas: bool) -> bool:
     """Whether a MultiReducer can run on the resident path: >=1 non-count
-    stat, all over one field, all ops resident-evaluable, no float-sum."""
+    stat, all ops resident-evaluable, no float-sum.  Stats over ONE field
+    share a single ring; stats over several fields get one ring each
+    (MultiFieldResidentExecutor)."""
     dev = winfunc.device_parts
     return (not use_pallas and bool(dev)
-            and winfunc.resident_field() is not None
             and all(p.op in _RESIDENT_OPS for p in dev)
             and not any(p.op == "sum"
                         and np.issubdtype(p.dtype, np.floating)
@@ -570,7 +670,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                                                            use_pallas):
             raise ValueError(
                 "MultiReducer runs on the resident device path only: "
-                "needs >=1 non-count stat, all over one field, ops in "
+                "needs >=1 non-count stat, ops in "
                 f"{_RESIDENT_OPS}, no float sum (got {winfunc.parts})")
         return ResidentWinSeqCore(
             spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
@@ -579,6 +679,20 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
             depth=depth if depth is not None else 8,
             compute_dtype=compute_dtype, worker_index=worker_index,
             mesh=mesh, max_delay_ms=max_delay_ms)
+    if (isinstance(winfunc, JaxWindowFunction) and use_resident
+            and not use_pallas and mesh is None):
+        # arbitrary JAX window fns evaluate over multi-field resident
+        # rings on request (use_resident=True); the default stays the
+        # segment-restaging executor, whose staged columns carry each
+        # launch's exact dtypes (rings are typed at allocation —
+        # JaxWindowFunction.field_dtypes declares them)
+        return ResidentWinSeqCore(
+            spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
+            config=config, role=role, map_indexes=map_indexes,
+            result_ts_slide=result_ts_slide, device=device,
+            depth=depth if depth is not None else 8,
+            compute_dtype=compute_dtype, worker_index=worker_index,
+            max_delay_ms=max_delay_ms)
     resident = use_resident
     if resident is None:
         resident = (not use_pallas and isinstance(winfunc, Reducer)
