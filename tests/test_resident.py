@@ -184,12 +184,27 @@ def test_resident_64bit_compute_dtype_needs_x64():
                                compute_dtype=np.int64)
 
 
-def test_resident_count_uses_legacy_path():
+def test_resident_count_skips_device_entirely():
+    """count needs no device work at all: it routes to the HOST core
+    (window lengths answer it), not to a restaging device core."""
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         core = make_core_for(WindowSpec(4, 2, WinType.CB), Reducer("count"))
-    assert isinstance(core, DeviceWinSeqCore)
-    assert not isinstance(core, ResidentWinSeqCore)
+    assert not isinstance(core, (DeviceWinSeqCore, ResidentWinSeqCore))
+    # max over the position field is host-free too (the archive is
+    # position-ordered), for both window kinds
+    from windflow_tpu.core.winseq import WinSeqCore as _Host
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mx_tb = make_core_for(WindowSpec(10, 5, WinType.TB),
+                              Reducer("max", "ts", "hi"))
+        mx_cb = make_core_for(WindowSpec(10, 5, WinType.CB),
+                              Reducer("max", "id", "hi"))
+        mx_val = make_core_for(WindowSpec(10, 5, WinType.CB),
+                               Reducer("max", "value"))
+    assert not isinstance(mx_tb, (DeviceWinSeqCore, ResidentWinSeqCore))
+    assert not isinstance(mx_cb, (DeviceWinSeqCore, ResidentWinSeqCore))
+    assert isinstance(mx_val, ResidentWinSeqCore)  # real device work
 
 
 def test_resident_rejects_incremental():
@@ -251,12 +266,20 @@ def test_multi_stat_mesh_matches_host():
                         np.sort(got, order=["key", "id"]), ("n", "hi"))
 
 
-def test_multi_stat_rejects_count_only():
+def test_multi_stat_count_only_routes_host_forced_device_rejects():
+    """A count-only MultiReducer is entirely host-free, so it routes to
+    the host core; forcing the device still raises (nothing to ship)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(4, 2, WinType.CB),
+                             MultiReducer(("count", None, "n")))
+    assert not isinstance(core, (DeviceWinSeqCore, ResidentWinSeqCore))
     with pytest.raises(ValueError, match="non-count"):
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             make_core_for(WindowSpec(4, 2, WinType.CB),
-                          MultiReducer(("count", None, "n")))
+                          MultiReducer(("count", None, "n")),
+                          use_resident=True)
 
 
 def test_multi_stat_two_fields_takes_multifield_rings():
@@ -451,3 +474,27 @@ def test_resident_float_column_into_int_ring_rejected():
     with pytest.raises(ValueError, match="float column"):
         core.process(b)
         core.flush()
+
+
+def test_host_free_tb_aggregate_routes_to_host_core():
+    """COUNT + MAX(ts) over TB windows has no device-worthy compute
+    (counts from lens, max-ts from the ts-ordered archive): make_core_for
+    routes it to the vectorised host core; use_resident=True still forces
+    the device ring (wire benchmarking)."""
+    from windflow_tpu.core.vecinc import VecIncTumblingCore
+    from windflow_tpu.ops.functions import MultiReducer
+
+    def agg():
+        return MultiReducer(("count", None, "n"), ("max", "ts", "hi"))
+
+    spec_args = (1000, 1000, WinType.TB)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(*spec_args), agg())
+        forced = make_core_for(WindowSpec(*spec_args), agg(),
+                               use_resident=True)
+        cb = make_core_for(WindowSpec(1000, 1000, WinType.CB), agg())
+    assert isinstance(core, VecIncTumblingCore)     # tumbling TB -> vec
+    assert isinstance(forced, ResidentWinSeqCore)   # explicit device
+    # CB windows: ts is NOT the position field, max(ts) needs real work
+    assert isinstance(cb, ResidentWinSeqCore)

@@ -121,6 +121,12 @@ def test_spatial_device_skyline_matches_host():
     farm = run_spatial(WinFarmTPU(device_skyline(), WIN, SLIDE, WinType.TB,
                                   pardegree=2, batch_len=8), batches)
     assert host == farm
+    # device-RESIDENT variant: the (x, y) columns live in float32 HBM
+    # rings (field_dtypes) and cross the wire once, instead of restaging
+    # every fired window's rows
+    res = run_spatial(WinSeqTPU(device_skyline(), WIN, SLIDE, WinType.TB,
+                                batch_len=16, use_resident=True), batches)
+    assert host == res
 
 
 # ----------------------------------------------------------------- k-means

@@ -107,7 +107,12 @@ def device_skyline():
         return size, checksum
 
     return JaxWindowFunction(fn, fields=("x", "y"),
-                             result_fields=dict(RESULT_FIELDS))
+                             result_fields=dict(RESULT_FIELDS),
+                             # device-resident variant (use_resident=True):
+                             # coordinate rings in float32, matching the
+                             # fn's on-device compute precision
+                             field_dtypes={"x": np.float32,
+                                           "y": np.float32})
 
 
 # ---------------------------------------------------------------- k-means

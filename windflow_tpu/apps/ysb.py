@@ -175,7 +175,7 @@ class YSBSink:
 def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
                    pardegree2: int, win_sec: float = 10.0,
                    chunk: int = 262144, batches=None, on_result=None,
-                   opt_level: int = 0):
+                   opt_level: int = 0, force_device: bool = False):
     """Assemble the YSB MultiPipe.  `variant`: 'kf' (test_ysb_kf) or 'wmr'
     (test_ysb_wmr).  Pass `batches` to override the timed generator with a
     deterministic list (tests)."""
@@ -204,12 +204,18 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
         agg = KeyFarm(YSBAggregateINC(), win_us, win_us, WinType.TB,
                       pardegree=pardegree2, name="ysb_kf")
     elif variant == "kf-tpu":
-        # the tracked yahoo_test_tpu config: the window stage evaluates on
-        # the device (DeviceWinSeqCore over the JAX aggregate)
+        # the tracked yahoo_test_tpu config.  YSB's aggregate (COUNT +
+        # MAX(ts) over TB windows) has NO device-worthy compute — counts
+        # come from window lengths and max-ts from the ts-ordered archive
+        # — so make_core_for routes it to the vectorised host core by
+        # default (the r1 regression was paying wire RTTs for exactly
+        # nothing); --force-device (use_resident=True) pins the window
+        # stage to the device-resident ring for wire benchmarking
         from ..patterns.win_seq_tpu import KeyFarmTPU
         agg = KeyFarmTPU(device_aggregate(), win_us, win_us, WinType.TB,
                          pardegree=pardegree2, batch_len=256,
-                         compute_dtype=np.int32, name="ysb_kf_tpu")
+                         compute_dtype=np.int32, name="ysb_kf_tpu",
+                         use_resident=True if force_device else None)
     elif variant == "wmr":
         agg = WinMapReduce(YSBAggregate(), YSBReduce(), win_us, win_us,
                            WinType.TB, map_degree=max(pardegree2, 2),
@@ -229,7 +235,8 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
     return pipe, sink, sent
 
 
-def warmup(variant, pardegree1, pardegree2, win_sec, chunk):
+def warmup(variant, pardegree1, pardegree2, win_sec, chunk,
+           force_device=False):
     """Compile-warm the device path before the timed run: pushes a few
     synthetic chunks through an identical pipeline so the XLA executables
     for the step's shape buckets are built and cached process-wide
@@ -245,21 +252,25 @@ def warmup(variant, pardegree1, pardegree2, win_sec, chunk):
 
     batches = list(event_batches(4.0, chunk, campaigns, time_fn=fake_clock))
     pipe, _, _ = build_pipeline(variant, 0, pardegree1, pardegree2,
-                                win_sec, chunk, batches=batches)
+                                win_sec, chunk, batches=batches,
+                                force_device=force_device)
     pipe.run_and_wait_end()
 
 
 def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
-        win_sec=10.0, chunk=262144, warm=None, opt_level=0):
+        win_sec=10.0, chunk=262144, warm=None, opt_level=0,
+        force_device=False):
     """Run the benchmark; returns the reference's four stdout metrics
     (test_ysb_kf.cpp:113-116)."""
     if warm is None:
-        warm = variant.endswith("-tpu")
+        warm = variant.endswith("-tpu") and force_device
     if warm:
-        warmup(variant, pardegree1, pardegree2, win_sec, chunk)
+        warmup(variant, pardegree1, pardegree2, win_sec, chunk,
+               force_device=force_device)
     pipe, sink, sent = build_pipeline(variant, duration_sec, pardegree1,
                                       pardegree2, win_sec, chunk,
-                                      opt_level=opt_level)
+                                      opt_level=opt_level,
+                                      force_device=force_device)
     t0 = time.perf_counter()
     pipe.run_and_wait_end()
     elapsed = time.perf_counter() - t0
@@ -291,9 +302,14 @@ def main(argv=None):
                     help="graph optimisation level for the wmr variant "
                          "(optimize_WinMapReduce; LEVEL2 removes the "
                          "MAP-collector/REDUCE-emitter boundary)")
+    ap.add_argument("--force-device", action="store_true",
+                    help="kf-tpu: pin the window stage to the device-"
+                         "resident ring even though YSB's aggregate is "
+                         "host-free (wire benchmarking)")
     a = ap.parse_args(argv)
     m = run(a.variant, a.length, a.pardegree1, a.pardegree2, a.win_sec,
-            a.chunk, warm=False if a.no_warmup else None, opt_level=a.opt)
+            a.chunk, warm=False if a.no_warmup else None, opt_level=a.opt,
+            force_device=a.force_device)
     print(f"[Main] Total generated messages are {m['generated']}")
     print(f"[Main] Total received results are {m['results']}")
     print(f"[Main] Latency (usec) {m['avg_latency_us']}")

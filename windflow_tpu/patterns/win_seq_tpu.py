@@ -628,6 +628,18 @@ class ResidentWinSeqCore(WinSeqCore):
 _RESIDENT_OPS = ("sum", "min", "max", "prod")
 
 
+def _host_free(spec: WindowSpec, winfunc) -> bool:
+    """True when every stat is free on the host: counts come from window
+    lengths, and ``max`` over the POSITION field (ts for TB, id for CB) is
+    the last archived row's value — archives are kept ordered by position
+    (stream_archive.hpp), so the host bookkeeping already holds the
+    answer.  Such aggregates have no device-worthy compute at all."""
+    pos_field = "id" if spec.win_type is WinType.CB else "ts"
+    parts = winfunc.parts if isinstance(winfunc, MultiReducer) else [winfunc]
+    return all(p.op == "count" or (p.op == "max" and p.field == pos_field)
+               for p in parts)
+
+
 def _multi_resident_ok(winfunc: MultiReducer, use_pallas: bool) -> bool:
     """Whether a MultiReducer can run on the resident path: >=1 non-count
     stat, all ops resident-evaluable, no float-sum.  Stats over ONE field
@@ -663,6 +675,20 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
     ``mesh`` the resident ring is sharded ``P('kf', None)`` across the mesh
     devices (one dispatch serves every key group over ICI)."""
     if isinstance(winfunc, MultiReducer):
+        if use_resident is None and mesh is None and _host_free(spec,
+                                                               winfunc):
+            # every stat is answerable from host bookkeeping (count from
+            # window lengths; max over the TB position field from the
+            # ts-ordered archive) — shipping the column to the device buys
+            # nothing but wire traffic (the r1 kf-tpu regression: YSB's
+            # count+MAX(ts) lost to the host path for exactly this
+            # reason).  Route to the host core; use_resident=True forces
+            # the device anyway (benchmarking the wire).
+            from .win_seq import WinSeq
+            return WinSeq(winfunc, spec.win_len, spec.slide_len,
+                          spec.win_type, config=config, role=role,
+                          map_indexes=map_indexes,
+                          result_ts_slide=result_ts_slide).make_core()
         # multi-stat windows are resident-only (the restaging executor has
         # no multi-output contract); count-only MultiReducers should be a
         # plain Reducer("count")
@@ -693,6 +719,14 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
             depth=depth if depth is not None else 8,
             compute_dtype=compute_dtype, worker_index=worker_index,
             max_delay_ms=max_delay_ms)
+    if (isinstance(winfunc, Reducer) and use_resident is None
+            and mesh is None and _host_free(spec, winfunc)):
+        # same routing as the MultiReducer case above: max over the
+        # position field / count carry no device-worthy compute
+        from .win_seq import WinSeq
+        return WinSeq(winfunc, spec.win_len, spec.slide_len, spec.win_type,
+                      config=config, role=role, map_indexes=map_indexes,
+                      result_ts_slide=result_ts_slide).make_core()
     resident = use_resident
     if resident is None:
         resident = (not use_pallas and isinstance(winfunc, Reducer)
