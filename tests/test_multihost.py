@@ -1,0 +1,103 @@
+"""Multi-host mesh layout (parallel/multihost.py): kf splits along the
+process (DCN) boundary so key groups never span hosts and sp/wf
+neighbours share a host's ICI.  Multi-host topology is simulated on the
+virtual 8-device CPU mesh by injecting a process_of mapping (4 devices
+per fake host)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from windflow_tpu.parallel.mesh import KF_AXIS, SP_AXIS, WF_AXIS
+from windflow_tpu.parallel.multihost import (initialize, local_kf_groups,
+                                             make_multihost_mesh,
+                                             process_for_keys)
+
+DEVS = jax.devices()
+if len(DEVS) < 8:
+    pytest.skip("needs the 8-device virtual CPU mesh (conftest)",
+                allow_module_level=True)
+
+#: simulate 2 hosts x 4 chips on the virtual devices
+FAKE_PID = {id(d): i // 4 for i, d in enumerate(DEVS[:8])}
+
+
+def pid_of(d):
+    return FAKE_PID[id(d)]
+
+
+def test_kf_splits_along_host_boundary():
+    mesh = make_multihost_mesh(n_sp=2, n_wf=1, devices=DEVS[:8],
+                               process_of=pid_of)
+    assert dict(mesh.shape) == {KF_AXIS: 4, WF_AXIS: 1, SP_AXIS: 2}
+    # every kf row's devices live on ONE host; kf rows are host-major
+    row_pids = [{pid_of(d) for d in mesh.devices[g].flat}
+                for g in range(4)]
+    assert row_pids == [{0}, {0}, {1}, {1}]
+    # every sp pair is intra-host (collectives ride ICI, not DCN)
+    for g in range(4):
+        for w in range(1):
+            pids = {pid_of(mesh.devices[g, w, s]) for s in range(2)}
+            assert len(pids) == 1
+
+
+def test_sp_cannot_span_hosts():
+    with pytest.raises(ValueError, match="ICI"):
+        make_multihost_mesh(n_sp=8, devices=DEVS[:8], process_of=pid_of)
+
+
+def test_uneven_hosts_rejected():
+    uneven = {id(d): (0 if i < 3 else 1) for i, d in enumerate(DEVS[:8])}
+    with pytest.raises(ValueError, match="disagree"):
+        make_multihost_mesh(n_sp=1, devices=DEVS[:8],
+                            process_of=lambda d: uneven[id(d)])
+
+
+def test_process_for_keys_matches_kf_rows():
+    mesh = make_multihost_mesh(n_sp=2, devices=DEVS[:8], process_of=pid_of)
+    keys = np.arange(40)
+    owner = process_for_keys(keys, mesh, process_of=pid_of)
+    # key -> kf group is key % 4; groups 0,1 on host 0, groups 2,3 on 1
+    np.testing.assert_array_equal(owner, np.where(keys % 4 < 2, 0, 1))
+    np.testing.assert_array_equal(
+        local_kf_groups(mesh, process_index=1, process_of=pid_of), [2, 3])
+
+
+def test_single_process_degenerates_to_plain_mesh():
+    mesh = make_multihost_mesh(n_sp=2, n_wf=2, devices=DEVS[:8],
+                               process_of=lambda d: 0)
+    assert dict(mesh.shape) == {KF_AXIS: 2, WF_AXIS: 2, SP_AXIS: 2}
+    # and the sharded streaming step runs on it end-to-end
+    from windflow_tpu.parallel.mesh import MeshStreamStep
+    rng = np.random.default_rng(0)
+    N, B, L = 32, 4, 8
+    flat = rng.integers(-9, 9, size=(2, N)).astype(np.int32)
+    lens = rng.integers(1, L + 1, size=(2, B)).astype(np.int32)
+    starts = rng.integers(0, N - L, size=(2, B)).astype(np.int32)
+    step = MeshStreamStep(mesh, op="sum")
+    got = np.asarray(step(flat, starts, lens))
+    want = np.stack([[flat[k, s:s + l].sum() for s, l in zip(starts[k],
+                                                             lens[k])]
+                     for k in range(2)])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_initialize_noop_only_for_explicit_single_process():
+    # the explicit single-process job has nothing to coordinate
+    initialize(num_processes=1)
+    # a zero-arg call must DELEGATE to jax's auto-detection, not no-op
+    # (on a real pod it is the canonical cluster-init spelling); here it
+    # either raises (no cluster) or is refused by an already-initialised
+    # backend — both prove it was not swallowed
+    with pytest.raises(Exception):
+        initialize()
+
+
+def test_custom_routing_changes_key_owners():
+    mesh = make_multihost_mesh(n_sp=2, devices=DEVS[:8], process_of=pid_of)
+    keys = np.arange(8)
+    flipped = process_for_keys(keys, mesh, process_of=pid_of,
+                               routing=lambda k, n: (k + 2) % n)
+    np.testing.assert_array_equal(
+        flipped, np.where((keys + 2) % 4 < 2, 0, 1))

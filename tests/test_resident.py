@@ -498,3 +498,13 @@ def test_host_free_tb_aggregate_routes_to_host_core():
     assert isinstance(forced, ResidentWinSeqCore)   # explicit device
     # CB windows: ts is NOT the position field, max(ts) needs real work
     assert isinstance(cb, ResidentWinSeqCore)
+
+
+def test_host_free_routing_honors_pallas_request():
+    """use_pallas=True must keep the device path even for host-free
+    reducers (Pallas benchmarking stays reachable)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(10, 5, WinType.TB),
+                             Reducer("max", "ts", "hi"), use_pallas=True)
+    assert isinstance(core, DeviceWinSeqCore)

@@ -1,0 +1,137 @@
+"""Multi-host execution: process-spanning meshes with DCN-aware layout.
+
+The reference is strictly single-process — "communication" is FastFlow
+shared-memory queues between pinned threads (SURVEY.md §2.8: no NCCL, no
+MPI, no sockets).  The TPU-native scale-out story goes further: a
+``jax.distributed``-initialised job sees every host's chips as one device
+set, and the streaming mesh axes (kf × wf × sp, parallel/mesh.py) extend
+across hosts with the axis→network mapping chosen so that
+
+* ``kf`` (key groups — Key_Farm parallelism) is split OVER HOSTS first:
+  key groups exchange nothing, so the slow inter-host DCN hops carry no
+  collective traffic at all;
+* ``sp`` (within-window partition — the psum/ring-ppermute axis) stays
+  INSIDE one host's slice, so its collectives ride ICI.
+
+This is the streaming analog of the scaling-book recipe "data-parallel
+over DCN, model-parallel over ICI".
+
+Deployment model: one engine process per host.  Host-side dataflow
+(sources, emitters, host operators) runs per process over its own keys —
+``process_for_keys`` gives the owner of each key, and a source that
+generates (or receives) only its own key range needs no cross-host hop at
+all, exactly like the reference's per-worker key partitioning
+(kf_nodes.hpp routing) lifted one level.  Device-side, the sharded
+executors (``MeshResidentExecutor``, ``MeshStreamStep``) run one SPMD
+program over the global mesh; XLA inserts the (absent, for kf) DCN
+collectives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import KF_AXIS, SP_AXIS, WF_AXIS
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, **kw):
+    """``jax.distributed.initialize`` pass-through.  A zero-arg call
+    DELEGATES to jax's cluster auto-detection (the canonical spelling on
+    a real multi-host TPU pod — swallowing it here would silently build
+    single-host meshes with wrong kf ownership).  The only no-op is the
+    EXPLICIT single-process job, ``num_processes=1`` with no coordinator:
+    there is nothing to coordinate."""
+    if (num_processes == 1 and coordinator_address is None
+            and process_id in (None, 0) and not kw):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id, **kw)
+
+
+def _group_by_process(devices, process_of=None):
+    """Devices grouped by owning process, process ids ascending.
+    ``process_of`` overrides the grouping (tests simulate multi-host on
+    virtual single-process devices by injecting a mapping)."""
+    pid = (process_of if process_of is not None
+           else (lambda d: d.process_index))
+    groups = {}
+    for d in devices:
+        groups.setdefault(pid(d), []).append(d)
+    return [groups[p] for p in sorted(groups)]
+
+
+def make_multihost_mesh(n_kf=None, n_sp: int = 1, n_wf: int = 1,
+                        devices=None, process_of=None) -> Mesh:
+    """A (kf, wf, sp) mesh over every process's devices with ``kf``
+    outermost ALONG THE PROCESS BOUNDARY: the first ``n_processes``
+    divisions of the kf axis are whole hosts, so no kf index spans two
+    hosts and every sp/wf neighbour lives on the same host (collectives
+    on ICI, nothing on DCN).
+
+    ``n_kf`` defaults to all remaining parallelism
+    (n_devices // (n_sp * n_wf)); passing it explicitly is validation
+    only — it must equal exactly ``n_hosts * per_host_share`` (this mesh
+    always spans every device; carve a subset with ``devices=``).
+    Constraint: ``n_sp * n_wf`` must divide each host's device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    per_proc = _group_by_process(devices, process_of)
+    n_local = len(per_proc[0])
+    if any(len(g) != n_local for g in per_proc):
+        raise ValueError(
+            f"hosts disagree on device count: {[len(g) for g in per_proc]}")
+    inner = n_sp * n_wf
+    if n_local % inner:
+        raise ValueError(
+            f"sp*wf = {inner} must divide the per-host device count "
+            f"{n_local} (sp collectives must stay on one host's ICI)")
+    kf_per_proc = n_local // inner
+    total_kf = kf_per_proc * len(per_proc)
+    if n_kf is None:
+        n_kf = total_kf
+    if n_kf != total_kf:
+        raise ValueError(
+            f"n_kf={n_kf} but the ({len(per_proc)} hosts x {n_local} "
+            f"devices) / (sp*wf={inner}) layout gives kf={total_kf}")
+    # grid[kf, wf, sp]: host-major kf, then each host's devices reshaped
+    # into its local (kf_per_proc, wf, sp) block
+    blocks = [np.asarray(g, dtype=object).reshape(kf_per_proc, n_wf, n_sp)
+              for g in per_proc]
+    grid = np.concatenate(blocks, axis=0)
+    return Mesh(grid, (KF_AXIS, WF_AXIS, SP_AXIS))
+
+
+def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
+                     routing=None) -> np.ndarray:
+    """Owning process id per key: key -> kf group -> the process whose
+    devices hold that kf row.  A multi-host source keeps only
+    ``process_for_keys(k, mesh) == my_pid`` and never ships rows over
+    DCN.  ``routing(keys, n_kf) -> groups`` must be the SAME function the
+    deployment's emitters use (default: key % n, default_routing) — a
+    mismatch would place rows on hosts that don't own their kf group."""
+    n_kf = int(mesh.shape[KF_AXIS])
+    if routing is None:
+        from ..runtime.emitters import default_routing as routing
+    pid = (process_of if process_of is not None
+           else (lambda d: d.process_index))
+    kf_owner = np.asarray(
+        [pid(mesh.devices[g, 0, 0]) for g in range(n_kf)])
+    return kf_owner[np.asarray(
+        routing(np.asarray(keys, dtype=np.int64), n_kf), dtype=np.int64)]
+
+
+def local_kf_groups(mesh: Mesh, process_index=None,
+                    process_of=None) -> np.ndarray:
+    """The kf-group indices whose device rows live on this process."""
+    if process_index is None:
+        process_index = jax.process_index()
+    n_kf = int(mesh.shape[KF_AXIS])
+    pid = (process_of if process_of is not None
+           else (lambda d: d.process_index))
+    return np.asarray([g for g in range(n_kf)
+                       if pid(mesh.devices[g, 0, 0]) == process_index])

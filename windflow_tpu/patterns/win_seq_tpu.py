@@ -622,9 +622,10 @@ class ResidentWinSeqCore(WinSeqCore):
                         "(win_seq_gpu.hpp supports NIC device functors)")
 
 
-#: reducer ops the resident path evaluates on device (count needs no device
-#: work and keeps the legacy path; arbitrary JAX fns need staged (B, pad)
-#: column views, which the segment-restaging executor provides)
+#: reducer ops the resident path evaluates on device (count carries no
+#: device work at all and routes to the HOST core via _host_free, as does
+#: max over the position field; arbitrary JAX fns default to the
+#: segment-restaging executor and opt into resident rings)
 _RESIDENT_OPS = ("sum", "min", "max", "prod")
 
 
@@ -674,21 +675,22 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
     the resident executor evaluates; segment-restaging otherwise.  With
     ``mesh`` the resident ring is sharded ``P('kf', None)`` across the mesh
     devices (one dispatch serves every key group over ICI)."""
+    if (isinstance(winfunc, (Reducer, MultiReducer))
+            and use_resident is None and mesh is None and not use_pallas
+            and _host_free(spec, winfunc)):
+        # every stat is answerable from host bookkeeping (count from
+        # window lengths; max over the position field from the
+        # position-ordered archive) — shipping the column to the device
+        # buys nothing but wire traffic (the r1 kf-tpu regression: YSB's
+        # count+MAX(ts) lost to the host path for exactly this reason).
+        # Route to the host core; use_resident=True forces the device and
+        # use_pallas=True keeps the Pallas/restaging path (benchmarking).
+        from .win_seq import WinSeq
+        return WinSeq(winfunc, spec.win_len, spec.slide_len,
+                      spec.win_type, config=config, role=role,
+                      map_indexes=map_indexes,
+                      result_ts_slide=result_ts_slide).make_core()
     if isinstance(winfunc, MultiReducer):
-        if use_resident is None and mesh is None and _host_free(spec,
-                                                               winfunc):
-            # every stat is answerable from host bookkeeping (count from
-            # window lengths; max over the TB position field from the
-            # ts-ordered archive) — shipping the column to the device buys
-            # nothing but wire traffic (the r1 kf-tpu regression: YSB's
-            # count+MAX(ts) lost to the host path for exactly this
-            # reason).  Route to the host core; use_resident=True forces
-            # the device anyway (benchmarking the wire).
-            from .win_seq import WinSeq
-            return WinSeq(winfunc, spec.win_len, spec.slide_len,
-                          spec.win_type, config=config, role=role,
-                          map_indexes=map_indexes,
-                          result_ts_slide=result_ts_slide).make_core()
         # multi-stat windows are resident-only (the restaging executor has
         # no multi-output contract); count-only MultiReducers should be a
         # plain Reducer("count")
@@ -719,14 +721,6 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
             depth=depth if depth is not None else 8,
             compute_dtype=compute_dtype, worker_index=worker_index,
             max_delay_ms=max_delay_ms)
-    if (isinstance(winfunc, Reducer) and use_resident is None
-            and mesh is None and _host_free(spec, winfunc)):
-        # same routing as the MultiReducer case above: max over the
-        # position field / count carry no device-worthy compute
-        from .win_seq import WinSeq
-        return WinSeq(winfunc, spec.win_len, spec.slide_len, spec.win_type,
-                      config=config, role=role, map_indexes=map_indexes,
-                      result_ts_slide=result_ts_slide).make_core()
     resident = use_resident
     if resident is None:
         resident = (not use_pallas and isinstance(winfunc, Reducer)
