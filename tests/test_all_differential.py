@@ -123,6 +123,16 @@ def test_all_device_compositions(wt):
                                        SLIDE, wt, map_degree=2,
                                        map_on_device=False,
                                        reduce_on_device=True, batch_len=32),
+        # nesting with device inner patterns (the reference's GPU nesting
+        # ctors III/IV, win_farm_gpu.hpp:227+, key_farm_gpu.hpp:167-334)
+        "kf+pf_tpu": KeyFarmOf(
+            PaneFarmTPU(Reducer("sum"), Reducer("sum"), WIN, SLIDE, wt,
+                        plq_degree=2, wlq_degree=2, wlq_on_device=False,
+                        batch_len=16), pardegree=2),
+        "wf+wmr_tpu": WinFarmOf(
+            WinMapReduceTPU(Reducer("sum"), Reducer("sum"), WIN, SLIDE, wt,
+                            map_degree=2, reduce_on_device=False,
+                            batch_len=16), pardegree=2),
     }
     for name, comp in device.items():
         got = run_windowed(comp, stream(wt))

@@ -508,3 +508,16 @@ def test_host_free_routing_honors_pallas_request():
         core = make_core_for(WindowSpec(10, 5, WinType.TB),
                              Reducer("max", "ts", "hi"), use_pallas=True)
     assert isinstance(core, DeviceWinSeqCore)
+
+
+def test_host_free_multireducer_ignores_pallas_flag():
+    """MultiReducer has no Pallas path, so use_pallas must not block its
+    host-free routing (it used to raise a misleading resident-only
+    error)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(
+            WindowSpec(10, 5, WinType.TB),
+            MultiReducer(("count", None, "c"), ("max", "ts", "hi")),
+            use_pallas=True)
+    assert not isinstance(core, (DeviceWinSeqCore, ResidentWinSeqCore))

@@ -676,15 +676,18 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
     ``mesh`` the resident ring is sharded ``P('kf', None)`` across the mesh
     devices (one dispatch serves every key group over ICI)."""
     if (isinstance(winfunc, (Reducer, MultiReducer))
-            and use_resident is None and mesh is None and not use_pallas
+            and use_resident is None and mesh is None
+            and (isinstance(winfunc, MultiReducer) or not use_pallas)
             and _host_free(spec, winfunc)):
         # every stat is answerable from host bookkeeping (count from
         # window lengths; max over the position field from the
         # position-ordered archive) — shipping the column to the device
         # buys nothing but wire traffic (the r1 kf-tpu regression: YSB's
         # count+MAX(ts) lost to the host path for exactly this reason).
-        # Route to the host core; use_resident=True forces the device and
-        # use_pallas=True keeps the Pallas/restaging path (benchmarking).
+        # Route to the host core.  use_resident=True forces the device;
+        # a Reducer with use_pallas=True keeps the Pallas/restaging path
+        # (benchmarking) — MultiReducer has no Pallas path, so the flag
+        # does not block its host routing.
         from .win_seq import WinSeq
         return WinSeq(winfunc, spec.win_len, spec.slide_len,
                       spec.win_type, config=config, role=role,
