@@ -12,6 +12,34 @@ from __future__ import annotations
 import numpy as np
 
 
+def segments(sorted_vals: np.ndarray):
+    """(starts, ends) of equal-value runs in a sorted array."""
+    starts = np.concatenate(([0], np.flatnonzero(np.diff(sorted_vals)) + 1))
+    ends = np.concatenate((starts[1:], [len(sorted_vals)]))
+    return starts, ends
+
+
+def segmented_excl_running_max(s: np.ndarray, p: np.ndarray,
+                               starts: np.ndarray,
+                               head_seed: np.ndarray) -> np.ndarray:
+    """Per-segment EXCLUSIVE running max of `p` (segments = equal runs of
+    the sorted `s`), seeded with `head_seed[i]` at segment i's head — the
+    vectorised form of the reference's per-row running-max ordering check
+    (win_seq.hpp:293-305), O(rows log rows) by Hillis-Steele doubling."""
+    q = p.copy()
+    q[starts] = np.maximum(q[starts], head_seed)
+    sh = 1
+    n = len(q)
+    while sh < n:
+        same = s[sh:] == s[:-sh]
+        np.maximum(q[sh:], np.where(same, q[:-sh], q[sh:]), out=q[sh:])
+        sh *= 2
+    excl = np.empty(n, dtype=np.int64)
+    excl[1:] = q[:-1]
+    excl[starts] = head_seed
+    return excl
+
+
 class SlotMap:
     """Dense int slots for int64 keys; lookup is O(rows log keys)."""
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .slots import segments as _segments
 from .tuples import MARKER_FIELD, Schema
 from .windows import PatternConfig, Role, WindowSpec, WinType
 from ..ops.functions import MultiReducer, Reducer
@@ -50,10 +51,6 @@ def vec_core_supported(spec: WindowSpec, winfunc) -> bool:
     return all(p.op == "count" or p.op in NP_UFUNCS for p in parts)
 
 
-def _segments(sorted_vals: np.ndarray):
-    starts = np.concatenate(([0], np.flatnonzero(np.diff(sorted_vals)) + 1))
-    ends = np.concatenate((starts[1:], [len(sorted_vals)]))
-    return starts, ends
 
 
 class VecIncTumblingCore:
@@ -174,23 +171,12 @@ class VecIncTumblingCore:
         head_bad = p[starts] < self._last_pos[s[starts]]
         keep_s = None
         if within_bad.any() or head_bad.any():
-            # segmented exclusive running max by doubling (O(rows log rows),
-            # no per-key Python even when every segment is disordered):
-            # q becomes the per-segment inclusive prefix max of p seeded
-            # with last_pos at segment heads; the exclusive shift of q is
-            # the reference's runmax (winseq.py _process_key)
-            q = p.copy()
-            q[starts] = np.maximum(q[starts], self._last_pos[s[starts]])
-            sh = 1
-            n_rows = len(q)
-            while sh < n_rows:
-                same = s[sh:] == s[:-sh]
-                np.maximum(q[sh:], np.where(same, q[:-sh], q[sh:]),
-                           out=q[sh:])
-                sh *= 2
-            excl = np.empty(n_rows, dtype=np.int64)
-            excl[1:] = q[:-1]
-            excl[starts] = self._last_pos[s[starts]]
+            # the shared segmented exclusive running max (core/slots.py):
+            # the reference's per-row runmax drop (win_seq.hpp:293-305)
+            # with no per-key Python even when every segment is disordered
+            from .slots import segmented_excl_running_max
+            excl = segmented_excl_running_max(s, p, starts,
+                                              self._last_pos[s[starts]])
             keep_s = p >= excl
         # update last_pos from surviving rows (win_seq.hpp updates it before
         # the initial_id filter)

@@ -22,84 +22,125 @@ class KeyedStreamState:
     out-of-order drop and the EOS-marker source (wf_nodes.hpp:60-121,
     wm_nodes.hpp:52-104).  Also absorbs markers arriving from an enclosing
     nesting emitter so this emitter's own markers carry the key's global
-    last tuple."""
+    last tuple.
 
-    __slots__ = ("pos_field", "last")
+    State is slot-indexed parallel arrays (core/slots.py), so a chunk's
+    bookkeeping — including the out-of-order slow path and the last-row
+    capture — is pure array arithmetic at any key cardinality (the dict
+    form collapsed into per-key Python at 10^5 keys)."""
+
+    __slots__ = ("pos_field", "_slots", "_last_pos", "_rows", "_n", "_cap")
 
     def __init__(self, pos_field: str):
+        from ..core.slots import SlotMap
         self.pos_field = pos_field
-        self.last = {}  # key -> (last_pos, last_row_copy)
+        self._slots = SlotMap(on_register=self._on_register)
+        self._last_pos = np.zeros(0, dtype=np.int64)
+        self._rows = None     # (cap,) structured array, slot-indexed
+        self._n = 0
+        self._cap = 0
+
+    def _on_register(self, new_keys):
+        m = len(new_keys)
+        if self._n + m > self._cap:
+            # amortised doubling: exact-size concatenate per registration
+            # is quadratic when keys trickle in across batches
+            self._cap = max(self._cap * 2, self._n + m, 1024)
+            grown = np.full(self._cap, _NEG_INF, dtype=np.int64)
+            grown[:self._n] = self._last_pos[:self._n]
+            self._last_pos = grown
+            if self._rows is not None:
+                gr = np.zeros(self._cap, dtype=self._rows.dtype)
+                gr[:self._n] = self._rows[:self._n]
+                self._rows = gr
+        self._n += m
+
+    def _rows_buf(self, dtype):
+        if self._rows is None or self._rows.dtype != dtype:
+            buf = np.zeros(self._cap, dtype=dtype)
+            if self._rows is not None:
+                common = min(len(self._rows), self._n)
+                for f in set(dtype.names) & set(self._rows.dtype.names):
+                    buf[f][:common] = self._rows[f][:common]
+            self._rows = buf
+        return self._rows
+
+    def _store_last(self, slots_of_rows, rows, sorted_order=None):
+        """Per-slot last-row capture: rows are in priority order (arrival,
+        or pos for markers), so the LAST occurrence per slot wins.
+        ``sorted_order`` passes a precomputed stable slot sort to avoid
+        re-sorting on the hot path."""
+        buf = self._rows_buf(rows.dtype)
+        order = (np.argsort(slots_of_rows, kind="stable")
+                 if sorted_order is None else sorted_order)
+        s = slots_of_rows[order]
+        last = np.ones(len(s), dtype=bool)
+        last[:-1] = s[1:] != s[:-1]
+        buf[s[last]] = rows[order[last]]
 
     def filter(self, batch: np.ndarray) -> np.ndarray:
         """Absorb marker rows and drop out-of-order rows; returns the
         surviving (real) rows, arrival order preserved."""
         mk = batch[MARKER_FIELD]
         if np.any(mk):
-            for row in batch[mk]:
-                k = int(row["key"])
-                p = int(row[self.pos_field])
-                prev = self.last.get(k)
-                if prev is None or p >= prev[0]:
-                    self.last[k] = (p, row.copy())
+            mrows = batch[mk]
+            mpos = mrows[self.pos_field].astype(np.int64)
+            mslots = self._slots.lookup(mrows["key"].astype(np.int64,
+                                                           copy=False))
+            ok = mpos >= self._last_pos[mslots]
+            if not ok.all():
+                mrows, mpos, mslots = mrows[ok], mpos[ok], mslots[ok]
+            if len(mrows):
+                # order by pos so the stored last row is the max-pos
+                # marker (ties: later arrival wins, like the dict form)
+                mo = np.argsort(mpos, kind="stable")
+                self._store_last(mslots[mo], mrows[mo])
+                np.maximum.at(self._last_pos, mslots, mpos)
             batch = batch[~mk]
         if len(batch) == 0:
             return batch
-        keys = batch["key"]
+        from ..core.slots import segmented_excl_running_max, segments
+        keys = batch["key"].astype(np.int64, copy=False)
         pos = batch[self.pos_field].astype(np.int64)
-        # fast path: per-key nondecreasing (the overwhelmingly common case
-        # for in-order streams) — one grouped monotonicity check, no
-        # per-key Python loop
-        from ..core.tuples import group_by_key
-        order, starts, _g_ends = group_by_key(keys)
-        ks = keys[order]
+        slots = self._slots.lookup(keys)
+        order = np.argsort(slots, kind="stable")
+        s = slots[order]
         ps = pos[order]
-        same_key = np.ones(len(ks), dtype=bool)
-        same_key[starts] = False
-        in_order = not np.any((np.diff(ps) < 0) & same_key[1:])
-        if in_order:
-            firsts = ps[starts]
-            lasts_idx = _g_ends - 1
-            ok_heads = True
-            for i, s in enumerate(starts):
-                k = int(ks[s])
-                prev = self.last.get(k)
-                if prev is not None and firsts[i] < prev[0]:
-                    ok_heads = False
-                    break
-            if ok_heads:
-                # ONE vectorised take of the last row per key, then O(K)
-                # dict stores of views into it (a per-key row.copy() here
-                # costs a python-level copy per distinct key per chunk)
-                lastrows = batch[order[lasts_idx]]
-                for i, li in enumerate(lasts_idx):
-                    self.last[int(ks[li])] = (int(ps[li]), lastrows[i])
-                return batch
-        # slow path: genuine out-of-order rows — per-key running max over
-        # contiguous sorted slices (O(n + K), not a mask per key)
-        ends = _g_ends
-        keep_sorted = np.ones(len(ks), dtype=bool)
-        for i in range(len(starts)):
-            sl = slice(int(starts[i]), int(ends[i]))
-            p = ps[sl]
-            k = int(ks[starts[i]])
-            prev = self.last.get(k)
-            lastpos = prev[0] if prev else _NEG_INF
-            runmax = np.maximum.accumulate(np.concatenate(([lastpos], p)))[:-1]
-            ok = p >= runmax
-            keep_sorted[sl] = ok
-            if ok.any():
-                li = int(starts[i]) + int(np.flatnonzero(ok)[-1])
-                self.last[k] = (int(ps[li]), batch[order[li]].copy())
+        starts, ends = segments(s)
+        seg_first = np.zeros(len(s), dtype=bool)
+        seg_first[starts] = True
+        within_bad = np.zeros(len(s), dtype=bool)
+        within_bad[1:] = (np.diff(ps) < 0) & ~seg_first[1:]
+        head_bad = ps[starts] < self._last_pos[s[starts]]
+        if not within_bad.any() and not head_bad.any():
+            # in-order fast path: store each key's last row, done
+            lasts = ends - 1
+            self._last_pos[s[lasts]] = ps[lasts]
+            self._store_last(slots, batch, sorted_order=order)
+            return batch
+        # out-of-order: the shared segmented exclusive running max
+        # (core/slots.py; also the vecinc drop pass)
+        excl = segmented_excl_running_max(s, ps, starts,
+                                          self._last_pos[s[starts]])
+        keep_sorted = ps >= excl
+        liv = np.flatnonzero(keep_sorted)
+        if len(liv):
+            ls, le = segments(s[liv])
+            self._last_pos[s[liv[ls]]] = ps[liv[le - 1]]
+            self._store_last(slots[order[liv]], batch[order[liv]],
+                             sorted_order=np.arange(len(liv)))
         keep = np.empty(len(batch), dtype=bool)
         keep[order] = keep_sorted
         return batch if keep.all() else batch[keep]
 
     def marker_batch(self) -> np.ndarray | None:
         """One marker row per key (its last tuple), for EOS replay."""
-        rows = [row for _, row in self.last.values() if row is not None]
-        if not rows:
+        if self._rows is None or self._n == 0:
             return None
-        markers = np.stack(rows)
+        seen = self._last_pos[:self._n] > _NEG_INF
+        if not seen.any():
+            return None
+        markers = self._rows[:self._n][seen].copy()
         markers[MARKER_FIELD] = True
         return markers
 
