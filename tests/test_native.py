@@ -282,8 +282,8 @@ def test_native_launch_coalescing_matches_host():
             if name != "wf_launch_coalesce":
                 return getattr(real, name)
 
-            def counting(h, cells, mx):
-                n = real.wf_launch_coalesce(h, cells, mx)
+            def counting(h, cells, mx, mult):
+                n = real.wf_launch_coalesce(h, cells, mx, mult)
                 merges.append(n)
                 return n
             return counting
@@ -470,3 +470,107 @@ def test_ship_thread_failure_cancels_dataflow(monkeypatch):
                         Sink(lambda r: None, vectorized=True)])
     with pytest.raises(RuntimeError, match="injected"):
         df.run_and_wait_end()
+
+
+def test_native_deep_coalescing_ladder():
+    """With the wire reported slow (mean service >= 50 ms), the buddy
+    ladder is allowed up to 16x: a stream producing hundreds of regular
+    launches must reach dispatch counts well below the 4x cap's floor,
+    with results still byte-identical to the host core."""
+    spec = WindowSpec(16, 4, WinType.CB)
+    batches = cb_stream(4, 20000, chunk=2048, seed=5)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    nat = make_native(spec, Reducer("sum"), batch_len=1 << 20,
+                      flush_rows=256, overlap=False)
+    # ~312 natural launches (4*20000/256); pretend the wire is stalled so
+    # the adaptive cap opens the full ladder
+    for ex in nat.executors:
+        ex.mean_service_s = lambda: 1.0
+    dispatches = []
+    for ex in nat.executors:
+        orig_r, orig_i = ex.launch_regular, ex.launch
+
+        def count_r(*a, _f=orig_r, **kw):
+            dispatches.append("r")
+            return _f(*a, **kw)
+
+        def count_i(*a, _f=orig_i, **kw):
+            dispatches.append("i")
+            return _f(*a, **kw)
+        ex.launch_regular, ex.launch = count_r, count_i
+    got = run_core(nat, batches)
+    assert_equal_results(host, got)
+    n_launch = 4 * 20000 // 256
+    # the 4x-capped ladder could at best reach ~n_launch/4 (plus rebases);
+    # the 16x ladder must do strictly better than that floor
+    assert len(dispatches) < n_launch // 4, (
+        f"{len(dispatches)} dispatches for ~{n_launch} launches — deep "
+        "coalescing did not engage")
+
+
+def test_native_rebase_launches_never_merge():
+    """ADVICE r2: try_merge must reject a rebase launch in either role (A
+    or B) — a rebase is a dispatch barrier.  Queue exactly [rebase,
+    regular] and coalesce: nothing may merge."""
+    spec = WindowSpec(8, 4, WinType.CB)
+    # flush_rows far above the feeds: each force_flush makes exactly one
+    # launch, so the queue is exactly [rebase, regular]
+    nat = make_native(spec, Reducer("sum"), batch_len=1 << 20,
+                      flush_rows=4096, overlap=False)
+    lib, h = nat._lib, nat._hs[0]
+    b1 = cb_stream(2, 32, chunk=32, seed=1)[0]
+    off = nat._field_offsets(b1)
+    itemsize, o_key, o_id, o_ts, o_mk, o_val = off
+
+    def feed(b):
+        bb = np.ascontiguousarray(b)
+        lib.wf_cores_process_mt(nat._harr, 1, bb.ctypes.data, len(bb),
+                                itemsize, o_key, o_id, o_ts, o_mk, o_val)
+
+    # first flush = rebase launch; second = regular continuation
+    feed(cb_stream(2, 64, chunk=64, seed=1)[0])
+    lib.wf_core_force_flush(h)
+    feed(batch_from_columns(SCHEMA, key=np.tile(np.arange(2), 64),
+                            id=np.repeat(np.arange(64, 128), 2),
+                            ts=np.repeat(np.arange(64, 128), 2),
+                            value=np.ones(128, dtype=np.int64)))
+    lib.wf_core_force_flush(h)
+    assert lib.wf_launch_pending(h) == 2
+    merged = lib.wf_launch_coalesce(h, 1 << 24, 16, 16)
+    assert merged == 0, "a rebase launch was merged"
+    assert lib.wf_launch_pending(h) == 2
+    # drain normally so results stay correct
+    host = run_core(WinSeqCore(spec, Reducer("sum")),
+                    [cb_stream(2, 64, chunk=64, seed=1)[0],
+                     batch_from_columns(
+                         SCHEMA, key=np.tile(np.arange(2), 64),
+                         id=np.repeat(np.arange(64, 128), 2),
+                         ts=np.repeat(np.arange(64, 128), 2),
+                         value=np.ones(128, dtype=np.int64))])
+    got = run_core(nat, [])
+    assert_equal_results(host, got)
+
+
+def test_prewarm_regular_ladder_covers_merged_shapes():
+    """After a run that compiled base regular buckets, the ladder prewarm
+    must add the {2x..16x} siblings the coalescer can produce (ring-
+    capped), so a wire-stalled timed run never compiles mid-flight."""
+    from windflow_tpu.ops import resident as R
+    spec = WindowSpec(16, 4, WinType.CB)
+    batches = cb_stream(4, 4000, chunk=2048, seed=11)
+    nat = make_native(spec, Reducer("sum"), batch_len=1 << 20,
+                      flush_rows=256, overlap=False)
+    run_core(nat, batches)
+    base = [k for k in R._STEP_CACHE if k[0] == "reg"]
+    assert base, "no regular buckets compiled"
+    n = R.prewarm_regular_ladder()
+    assert n > 0
+    for key in base:
+        _t, op, cap, Rb, KP, C, blk_dt, acc_dt, slide = key
+        for m in (2, 4, 8, 16):
+            if Rb * m > cap or (KP // 2 + 1) * Rb * m > (1 << 24):
+                continue
+            sk = ("reg", op, cap, Rb * m, KP, C * m, blk_dt, acc_dt, slide)
+            assert sk in R._STEP_CACHE, f"ladder sibling missing: {sk}"
+    # idempotent: a second call has nothing left to do
+    assert R.prewarm_regular_ladder() == 0

@@ -521,3 +521,36 @@ def test_host_free_multireducer_ignores_pallas_flag():
             MultiReducer(("count", None, "c"), ("max", "ts", "hi")),
             use_pallas=True)
     assert not isinstance(core, (DeviceWinSeqCore, ResidentWinSeqCore))
+
+
+def test_acc_dtype_warning_gated_on_value_range():
+    """VERDICT r2 hygiene: the int32-accumulate wrap warning must not fire
+    when the Reducer's declared value_range plus the CB window length prove
+    the results fit (bench/YSB configs run warning-clean); it still fires
+    when no range is declared or the range genuinely overflows."""
+    import warnings
+    from windflow_tpu.core.windows import WindowSpec, WinType
+    from windflow_tpu.patterns import win_seq_tpu
+    from windflow_tpu.patterns.win_seq_tpu import select_acc_dtype
+
+    spec = WindowSpec(256, 64, WinType.CB)
+    tb = WindowSpec(256, 64, WinType.TB)
+
+    def fires(reducer, spec_):
+        win_seq_tpu._ACC_WARNED.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            acc = select_acc_dtype(reducer, None, spec_)
+        assert acc == np.dtype(np.int32)
+        return any("wrap" in str(x.message) for x in w)
+
+    # provably safe: |sum| <= 256 * 100 << 2^31
+    assert not fires(Reducer("sum", value_range=(0, 100)), spec)
+    # min/max never leave the input range, even for TB windows
+    assert not fires(Reducer("max", value_range=(-7, 10 ** 6)), tb)
+    # no declared range -> warn (the pre-r3 behavior)
+    assert fires(Reducer("sum"), spec)
+    # TB sum: row count unbounded, range proves nothing -> warn
+    assert fires(Reducer("sum", value_range=(0, 100)), tb)
+    # declared range too wide for the window length -> warn
+    assert fires(Reducer("sum", value_range=(0, 2 ** 40)), spec)

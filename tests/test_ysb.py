@@ -27,7 +27,8 @@ def fixed_batches(n_events, chunk=1000, ts_step_us=50):
         out.append(batch_from_columns(
             EVENT_SCHEMA, key=np.zeros(len(v), dtype=np.int64), id=v,
             ts=v * ts_step_us, ad_id=vm % campaigns.n_ads,
-            event_type=(vm % 3).astype(np.int8)))
+            event_type=(vm % 3).astype(np.int8),
+            revenue=(vm % 97) + 1))
     return out
 
 
@@ -53,7 +54,8 @@ class Collect:
     def __call__(self, live):
         with self._lock:
             self.rows.extend(
-                (int(r["key"]), int(r["count"]), int(r["lastUpdate"]))
+                (int(r["key"]), int(r["count"]), int(r["lastUpdate"]),
+                 int(r["revenue"]))
                 for r in live)
 
 
@@ -73,10 +75,10 @@ def test_ysb_counts_match_oracle(variant):
     assert sent[0] == n
     want = oracle_counts(n)
     # sum of per-window counts == number of filtered+joined events
-    assert sum(c for _, c, _ in got.rows) == sum(want.values())
+    assert sum(c for _, c, *_ in got.rows) == sum(want.values())
     # per-campaign totals match
     per_cmp = {}
-    for k, c, _ in got.rows:
+    for k, c, *_ in got.rows:
         per_cmp[k] = per_cmp.get(k, 0) + c
     want_cmp = {}
     for (c, _), n_ in want.items():
@@ -98,8 +100,8 @@ def test_ysb_kf_wmr_differential():
     multisets — the test_all differential idea applied to YSB."""
     a, _, _ = run_variant("kf")
     b, _, _ = run_variant("wmr")
-    assert sorted((k, c) for k, c, _ in a.rows) == \
-        sorted((k, c) for k, c, _ in b.rows)
+    assert sorted((k, c) for k, c, *_ in a.rows) == \
+        sorted((k, c) for k, c, *_ in b.rows)
 
 
 def test_ysb_last_update_is_window_max_ts():
@@ -122,7 +124,7 @@ def test_ysb_last_update_is_window_max_ts():
     for (c, _), t in want_max.items():
         want_by_key.setdefault(c, []).append(t)
     got_by_key = {}
-    for k, _, lu in got.rows:
+    for k, _, lu, _r in got.rows:
         got_by_key.setdefault(k, []).append(lu)
     assert {k: sorted(v) for k, v in got_by_key.items()} == \
         {k: sorted(v) for k, v in want_by_key.items()}
@@ -131,12 +133,38 @@ def test_ysb_last_update_is_window_max_ts():
 def test_ysb_aggregate_batch_matches_scalar():
     agg = YSBAggregate()
     rng = np.random.default_rng(0)
-    rows = np.zeros(17, dtype=[("ts", np.int64)])
+    rows = np.zeros(17, dtype=[("ts", np.int64), ("revenue", np.int64)])
     rows["ts"] = rng.integers(0, 1000, 17)
+    rows["revenue"] = rng.integers(1, 98, 17)
     want = agg.apply(0, 0, rows)
     pad = 32
     ts_col = np.zeros((1, pad), dtype=np.int64)
     ts_col[0, :17] = rows["ts"]
+    rev_col = np.zeros((1, pad), dtype=np.int64)
+    rev_col[0, :17] = rows["revenue"]
     got = agg.apply_batch(np.zeros(1), np.zeros(1),
-                          {"ts": ts_col}, np.array([17]))
-    assert (int(got["count"][0]), int(got["lastUpdate"][0])) == want
+                          {"ts": ts_col, "revenue": rev_col},
+                          np.array([17]))
+    assert (int(got["count"][0]), int(got["lastUpdate"][0]),
+            int(got["revenue"][0])) == want
+
+
+def test_ysb_revenue_matches_oracle():
+    """r3: the device-worthy SUM(revenue) must equal the per-campaign
+    oracle on both the host and the device variants."""
+    campaigns = CampaignGenerator()
+    n = 30000
+    v = np.arange(n, dtype=np.int64)
+    vm = v % 100000
+    keep = vm % 3 == 0
+    cmp_ids = campaigns.ad_to_cmp[(vm % campaigns.n_ads)[keep]]
+    rev = ((vm % 97) + 1)[keep]
+    want_cmp = {}
+    for c, r in zip(cmp_ids, rev):
+        want_cmp[int(c)] = want_cmp.get(int(c), 0) + int(r)
+    for variant in ("kf", "kf-tpu"):
+        got, _, _ = run_variant(variant, n_events=n)
+        per_cmp = {}
+        for k, _c, _lu, r in got.rows:
+            per_cmp[k] = per_cmp.get(k, 0) + r
+        assert per_cmp == want_cmp, variant

@@ -41,8 +41,10 @@ from ..patterns.win_mapreduce import WinMapReduce
 N_CAMPAIGNS = 100          # -DN_CAMPAIGNS=100 (yahoo Makefile:26)
 ADS_PER_CAMPAIGN = 10      # CampaignGenerator default
 
-EVENT_SCHEMA = Schema(ad_id=np.int64, event_type=np.int8)
-JOINED_SCHEMA = Schema()   # key=cmp_id, ts carries the event time
+EVENT_SCHEMA = Schema(ad_id=np.int64, event_type=np.int8,
+                      revenue=np.int64)
+#: key=cmp_id, ts carries the event time; revenue rides to the aggregate
+JOINED_SCHEMA = Schema(revenue=np.int64)
 
 
 class CampaignGenerator:
@@ -59,24 +61,31 @@ class CampaignGenerator:
 
 
 class YSBAggregate(WindowFunction):
-    """Per-campaign tumbling-window COUNT(*) + MAX(ts)
-    (aggregateFunctionINC, yahoo_app.hpp:150-156)."""
+    """Per-campaign tumbling-window COUNT(*) + MAX(ts) + SUM(revenue)
+    (aggregateFunctionINC, yahoo_app.hpp:150-168; the revenue sum is the
+    r3 extension making the aggregate device-worthy — counts and max-ts
+    are answerable from host bookkeeping alone, a per-event revenue fold
+    is not)."""
 
-    result_fields = {"count": np.int64, "lastUpdate": np.int64}
-    required_fields = ("ts",)  # staged to apply_batch / the device path
+    result_fields = {"count": np.int64, "lastUpdate": np.int64,
+                     "revenue": np.int64}
+    required_fields = ("ts", "revenue")  # staged to apply_batch / device
 
     def apply(self, key, gwid, rows):
         return (len(rows),
-                int(rows["ts"].max()) if len(rows) else 0)
+                int(rows["ts"].max()) if len(rows) else 0,
+                int(rows["revenue"].sum()) if len(rows) else 0)
 
     def apply_batch(self, keys, gwids, cols, lens):
-        # ts is a header column; reconstruct MAX(ts) from the window extents
-        # is not possible in general, so this path receives ts via cols
+        # ts is a header column; reconstructing MAX(ts) from the window
+        # extents is not possible in general, so this path receives ts via
+        # cols
         ts = cols["ts"]
         pad = ts.shape[1]
         mask = np.arange(pad)[None, :] < lens[:, None]
         return {"count": lens.astype(np.int64),
-                "lastUpdate": np.where(mask, ts, 0).max(axis=1)}
+                "lastUpdate": np.where(mask, ts, 0).max(axis=1),
+                "revenue": np.where(mask, cols["revenue"], 0).sum(axis=1)}
 
 
 class YSBAggregateINC(WindowUpdate):
@@ -85,42 +94,54 @@ class YSBAggregateINC(WindowUpdate):
     O(1) state per open window, no archive.  This is what the kf variant
     runs; the NIC twin above serves the WMR MAP stage and the device path."""
 
-    result_fields = {"count": np.int64, "lastUpdate": np.int64}
+    result_fields = {"count": np.int64, "lastUpdate": np.int64,
+                     "revenue": np.int64}
 
     def update(self, key, gwid, row, acc):
         acc["count"] += 1
         acc["lastUpdate"] = max(acc["lastUpdate"], row["ts"])
+        acc["revenue"] += row["revenue"]
 
     def update_many(self, key, gwid, rows, acc):
         if len(rows):
             acc["count"] += len(rows)
             acc["lastUpdate"] = max(int(acc["lastUpdate"]),
                                     int(rows["ts"].max()))
+            acc["revenue"] += int(rows["revenue"].sum())
 
 
 class YSBReduce(WindowFunction):
     """Combine per-partition partials (reduceFunctionINC,
     yahoo_app.hpp:159-165)."""
 
-    result_fields = {"count": np.int64, "lastUpdate": np.int64}
+    result_fields = {"count": np.int64, "lastUpdate": np.int64,
+                     "revenue": np.int64}
 
     def apply(self, key, gwid, rows):
         return (int(rows["count"].sum()) if len(rows) else 0,
-                int(rows["lastUpdate"].max()) if len(rows) else 0)
+                int(rows["lastUpdate"].max()) if len(rows) else 0,
+                int(rows["revenue"].sum()) if len(rows) else 0)
 
 
 def device_aggregate():
     """The YSB aggregate as a multi-stat resident reduction: COUNT(*) +
-    MAX(ts) (yahoo_app.hpp:150-156).  The ts column crosses the wire ONCE
-    into the device-resident ring (ops/resident.py); MAX evaluates in one
-    fused dispatch per flush and COUNT is answered host-side from the
-    window lengths — no per-fire restaging (the r1 kf-tpu regression).
-    Event timestamps are relative microseconds (event_batches), so the
-    int32 accumulate dtype is exact for runs under ~35 minutes."""
-    from ..ops.functions import MultiReducer
+    MAX(ts) + SUM(revenue) (yahoo_app.hpp:150-168).  SUM(revenue) is NOT
+    host-free (r2 VERDICT item 5: counts come from window lengths and
+    max-ts from the position-ordered archive, but a per-event revenue fold
+    is real device work), so this routes to the multi-field resident
+    rings: the ts and revenue columns each cross the wire ONCE and every
+    stat evaluates in one fused dispatch per flush (ops/resident.py:
+    MultiFieldResidentExecutor).  Event timestamps are relative
+    microseconds (event_batches), so the declared value_range proves the
+    int32 accumulate exact for runs under ~35 minutes; per-event revenue
+    is < 100, summed in int32 result dtype."""
+    from ..ops.functions import MultiReducer, Reducer
 
-    return MultiReducer(("count", None, "count"),
-                        ("max", "ts", "lastUpdate"))
+    return MultiReducer(
+        Reducer("count", out_field="count"),
+        Reducer("max", "ts", "lastUpdate",
+                value_range=(0, 2_100_000_000)),
+        Reducer("sum", "revenue", "revenue", dtype=np.int32))
 
 
 def event_batches(duration_sec: float, chunk: int, campaigns,
@@ -140,7 +161,8 @@ def event_batches(duration_sec: float, chunk: int, campaigns,
         yield batch_from_columns(
             EVENT_SCHEMA, key=np.zeros(chunk, dtype=np.int64),
             id=v, ts=ts, ad_id=vm % n_ads,
-            event_type=(vm % 3).astype(np.int8))
+            event_type=(vm % 3).astype(np.int8),
+            revenue=(vm % 97) + 1)
         v0 += chunk
 
 
@@ -194,8 +216,10 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
 
     def join(b, out):
         # re-key each surviving event by its campaign id (id/ts flow
-        # through via the non-in-place Map header copy)
+        # through via the non-in-place Map header copy; payload columns
+        # must be forwarded explicitly)
         out["key"] = ad_to_cmp[b["ad_id"]]
+        out["revenue"] = b["revenue"]
 
     start_wall_us = int(time.time() * 1e6)
     sink = YSBSink(start_wall_us, on_result=on_result)
@@ -204,17 +228,17 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
         agg = KeyFarm(YSBAggregateINC(), win_us, win_us, WinType.TB,
                       pardegree=pardegree2, name="ysb_kf")
     elif variant == "kf-tpu":
-        # the tracked yahoo_test_tpu config.  YSB's aggregate (COUNT +
-        # MAX(ts) over TB windows) has NO device-worthy compute — counts
-        # come from window lengths and max-ts from the ts-ordered archive
-        # — so make_core_for routes it to the vectorised host core by
-        # default (the r1 regression was paying wire RTTs for exactly
-        # nothing); --force-device (use_resident=True) pins the window
-        # stage to the device-resident ring for wire benchmarking
+        # the tracked yahoo_test_tpu config: COUNT + MAX(ts) + SUM(revenue)
+        # over multi-field device-resident rings.  The revenue sum gives
+        # the window stage real device compute (r2 VERDICT item 5 — the r2
+        # aggregate was host-free and make_core_for rightly routed it to
+        # the host, leaving the tracked config deviceless); --force-device
+        # is retained as an explicit pin (the default already selects the
+        # resident path now that the aggregate is not host-free)
         from ..patterns.win_seq_tpu import KeyFarmTPU
         agg = KeyFarmTPU(device_aggregate(), win_us, win_us, WinType.TB,
                          pardegree=pardegree2, batch_len=256,
-                         compute_dtype=np.int32, name="ysb_kf_tpu",
+                         name="ysb_kf_tpu",
                          use_resident=True if force_device else None)
     elif variant == "wmr":
         agg = WinMapReduce(YSBAggregate(), YSBReduce(), win_us, win_us,
@@ -263,7 +287,9 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
     """Run the benchmark; returns the reference's four stdout metrics
     (test_ysb_kf.cpp:113-116)."""
     if warm is None:
-        warm = variant.endswith("-tpu") and force_device
+        # device variants warm by default: kf-tpu's aggregate now carries
+        # real device compute (SUM(revenue)) whether or not it is pinned
+        warm = variant.endswith("-tpu")
     if warm:
         warmup(variant, pardegree1, pardegree2, win_sec, chunk,
                force_device=force_device)

@@ -57,11 +57,17 @@ class SlotMap:
     def _register(self, new_keys: np.ndarray):
         uniq, first_idx = np.unique(new_keys, return_index=True)
         k = uniq[np.argsort(first_idx)]              # first-appearance order
+        new_slots = np.arange(self.n, self.n + len(k), dtype=np.int64)
         self.keys = np.concatenate((self.keys[:self.n], k))
         self.n += len(k)
-        order = np.argsort(self.keys, kind="stable")
-        self._sorted_keys = self.keys[order]
-        self._sorted_slots = order.astype(np.int64)
+        # merge the m new keys into the sorted view (O(K + m log m)): a
+        # full re-argsort here is O(K log K) *per registration*, quadratic
+        # total when keys trickle in one-per-chunk (ADVICE r2)
+        order = np.argsort(k, kind="stable")
+        ks, ss = k[order], new_slots[order]
+        pos = np.searchsorted(self._sorted_keys, ks)
+        self._sorted_keys = np.insert(self._sorted_keys, pos, ks)
+        self._sorted_slots = np.insert(self._sorted_slots, pos, ss)
         if self._on_register is not None:
             self._on_register(k)
 

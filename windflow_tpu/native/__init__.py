@@ -96,7 +96,7 @@ def _bind(lib):
     lib.wf_launch_peek_regular.restype = ctypes.c_int
     lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
     lib.wf_launch_coalesce.restype = i64
-    lib.wf_launch_coalesce.argtypes = [ctypes.c_void_p, i64, i64]
+    lib.wf_launch_coalesce.argtypes = [ctypes.c_void_p, i64, i64, i64]
     lib.wf_launch_take_regular.argtypes = [ctypes.c_void_p, p_i32,
                                            p_i32, p_i32, p_i32]
     lib.wf_queue_new.restype = ctypes.c_void_p

@@ -115,7 +115,7 @@ class Reducer(WindowFunction, WindowUpdate):
     """
 
     def __init__(self, op: str, field: str = "value", out_field: str = None,
-                 dtype=np.int64):
+                 dtype=np.int64, value_range=None):
         if op == "count":
             self.ufunc = None
         else:
@@ -126,6 +126,11 @@ class Reducer(WindowFunction, WindowUpdate):
         self.dtype = np.dtype(dtype)
         self.result_fields = {self.out_field: self.dtype}
         self.required_fields = () if op == "count" else (self.field,)
+        #: optional (lo, hi) bound on the input field's values — lets the
+        #: device path prove a narrow accumulate dtype cannot wrap (e.g.
+        #: values in [0, 100) summed over a 256-row window fit int32) and
+        #: skip the wrap warning that would otherwise fire on dtypes alone
+        self.value_range = value_range
 
     # identity element for empty windows / fresh accumulators
     def _identity(self):

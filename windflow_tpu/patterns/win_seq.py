@@ -66,15 +66,16 @@ class WinSeq(_Pattern):
         self.map_indexes = map_indexes
 
     def make_core(self) -> WinSeqCore:
-        # Tumbling windows over a monoid reducer take the vectorised
-        # multi-key core: identical INC semantics (== NIC for a monoid),
-        # O(rows) per chunk regardless of key cardinality. WF_NO_VECCORE=1
-        # forces the reference per-key core (debugging / differential runs).
+        # Tumbling/sliding windows over a monoid reducer take the
+        # vectorised multi-key core: identical INC semantics (== NIC for a
+        # monoid), O(rows log rows) per chunk regardless of key
+        # cardinality.  WF_NO_VECCORE=1 forces the reference per-key core
+        # (debugging / differential runs).
         import os
-        from ..core.vecinc import VecIncTumblingCore, vec_core_supported
+        from ..core.vecinc import make_vec_core, vec_core_supported
         if (vec_core_supported(self.spec, self.winfunc)
                 and not os.environ.get("WF_NO_VECCORE")):
-            return VecIncTumblingCore(
+            return make_vec_core(
                 self.spec, self.winfunc, config=self.config, role=self.role,
                 map_indexes=self.map_indexes,
                 result_ts_slide=self.result_ts_slide)

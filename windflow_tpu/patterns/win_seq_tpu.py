@@ -216,12 +216,35 @@ class DeviceWinSeqCore(WinSeqCore):
 _ACC_WARNED = set()
 
 
-def select_acc_dtype(reducer: Reducer, compute_dtype) -> np.dtype:
+def _acc_range_safe(reducer: Reducer, acc: np.dtype, spec) -> bool:
+    """True when the reducer's declared ``value_range`` proves its window
+    results cannot exceed ``acc``'s range: min/max never leave the input
+    range; a CB window's sum is bounded by win_len * max|value| (a TB
+    window's row count is unbounded, so sums stay unprovable there)."""
+    vr = getattr(reducer, "value_range", None)
+    if vr is None or acc.kind == "f":
+        return False
+    m = max(abs(int(vr[0])), abs(int(vr[1])))
+    if reducer.op in ("min", "max"):
+        bound = m
+    elif (reducer.op == "sum" and spec is not None
+          and spec.win_type is WinType.CB):
+        bound = m * int(spec.win_len)
+    else:
+        return False
+    info = np.iinfo(acc)
+    return -bound >= info.min and bound <= info.max
+
+
+def select_acc_dtype(reducer: Reducer, compute_dtype,
+                     spec: WindowSpec = None) -> np.dtype:
     """Accumulate dtype for the resident device path: int32/float32 by
     default (TPU-native widths), overridable via ``compute_dtype``.  Warns
-    when the reducer's result dtype exceeds the accumulate range; raises if
-    a 64-bit accumulate dtype is requested without jax x64 enabled (jax
-    would silently canonicalize the buffers back down to 32-bit)."""
+    when the reducer's result dtype exceeds the accumulate range — unless
+    the reducer's declared ``value_range`` plus the window shape prove the
+    results fit; raises if a 64-bit accumulate dtype is requested without
+    jax x64 enabled (jax would silently canonicalize the buffers back down
+    to 32-bit)."""
     if compute_dtype is not None:
         acc = np.dtype(compute_dtype)
     elif np.issubdtype(reducer.dtype, np.floating):
@@ -235,7 +258,8 @@ def select_acc_dtype(reducer: Reducer, compute_dtype) -> np.dtype:
                 f"compute_dtype={acc} needs jax x64 enabled "
                 "(jax.config.update('jax_enable_x64', True)); without it "
                 "jax silently truncates device buffers to 32 bits")
-    elif reducer.dtype.itemsize > acc.itemsize:
+    elif (reducer.dtype.itemsize > acc.itemsize
+          and not _acc_range_safe(reducer, acc, spec)):
         key = (reducer.op, reducer.dtype.str, acc.str)
         if key not in _ACC_WARNED:
             _ACC_WARNED.add(key)
@@ -243,7 +267,9 @@ def select_acc_dtype(reducer: Reducer, compute_dtype) -> np.dtype:
             warnings.warn(
                 f"resident device path accumulates in {acc}; {reducer.op} "
                 "results beyond its range will wrap — pass compute_dtype "
-                "for wide ranges (warned once per configuration)",
+                "for wide ranges, or declare the field's value_range on "
+                "the Reducer to prove the fit (warned once per "
+                "configuration)",
                 stacklevel=4)
     return acc
 
@@ -341,7 +367,7 @@ class ResidentWinSeqCore(WinSeqCore):
             # field_dtypes (default int32)
             acc_by_field = {}
             for p in self._device_parts:
-                a = select_acc_dtype(p, compute_dtype)
+                a = select_acc_dtype(p, compute_dtype, spec)
                 prev = acc_by_field.get(p.field)
                 if prev is not None and prev.kind != a.kind:
                     raise ValueError(
@@ -370,7 +396,7 @@ class ResidentWinSeqCore(WinSeqCore):
                 device=resolve_worker_device(device, worker_index),
                 depth=depth)
         else:
-            accs = [select_acc_dtype(p, compute_dtype)
+            accs = [select_acc_dtype(p, compute_dtype, spec)
                     for p in self._device_parts]
             kinds = {d.kind for d in accs}
             if len(kinds) > 1:
@@ -745,13 +771,20 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                 "use_pallas, and for float sums opt in explicitly with "
                 "use_resident=True (cumsum rounding differs from the "
                 "host's per-window reduction)")
-        return ResidentWinSeqCore(
-            spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
-            config=config, role=role, map_indexes=map_indexes,
-            result_ts_slide=result_ts_slide,
-            depth=depth if depth is not None else 8,
-            compute_dtype=compute_dtype, mesh=mesh,
-            max_delay_ms=max_delay_ms)
+        kw = dict(batch_len=batch_len, flush_rows=flush_rows,
+                  config=config, role=role, map_indexes=map_indexes,
+                  result_ts_slide=result_ts_slide,
+                  depth=depth if depth is not None else 8,
+                  compute_dtype=compute_dtype, mesh=mesh,
+                  max_delay_ms=max_delay_ms)
+        from ..native import enabled
+        if enabled() is not None:
+            # the C++ bookkeeping feeds the sharded ring: a real pod's
+            # multi-chip path must not re-pay the Python hot loop the
+            # native core was built to kill (r2 weak #3)
+            from .native_core import NativeResidentCore
+            return NativeResidentCore(spec, winfunc, shards=1, **kw)
+        return ResidentWinSeqCore(spec, winfunc, **kw)
     if resident:
         kw = dict(batch_len=batch_len, flush_rows=flush_rows, config=config,
                   role=role, map_indexes=map_indexes,

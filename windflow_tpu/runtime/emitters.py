@@ -56,13 +56,16 @@ class KeyedStreamState:
         self._n += m
 
     def _rows_buf(self, dtype):
-        if self._rows is None or self._rows.dtype != dtype:
-            buf = np.zeros(self._cap, dtype=dtype)
-            if self._rows is not None:
-                common = min(len(self._rows), self._n)
-                for f in set(dtype.names) & set(self._rows.dtype.names):
-                    buf[f][:common] = self._rows[f][:common]
-            self._rows = buf
+        if self._rows is None:
+            self._rows = np.zeros(self._cap, dtype=dtype)
+        elif self._rows.dtype != dtype:
+            # a mid-stream schema change would silently zero the columns
+            # absent from the old dtype in every captured last-row (EOS
+            # marker replay) — upstream schemas are fixed at build time, so
+            # this is a bug upstream: fail loudly (ADVICE r2)
+            raise TypeError(
+                f"batch dtype changed mid-stream: {self._rows.dtype} -> "
+                f"{dtype} (operator schemas are fixed at graph build)")
         return self._rows
 
     def _store_last(self, slots_of_rows, rows, sorted_order=None):
