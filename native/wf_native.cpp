@@ -136,6 +136,10 @@ struct Launch {
     std::vector<int32_t> rows;        // K per-key valid row counts in blk
     std::vector<int32_t> wrows, wstarts, wlens;   // B window descriptors
     std::vector<i64> hkey, hid, hts, hlen;        // B result headers
+    std::vector<i64> hpmax;   // B per-window max position (free from the
+                              // ordered archive: the window's last row) —
+                              // host-side MAX(ts)/MAX(id) for multi-stat
+                              // aggregates without shipping the column
 };
 
 struct Core {
@@ -154,7 +158,7 @@ struct Core {
 
     // pending fired windows (absolute row coords; ring coords at flush)
     std::vector<int32_t> wrow;
-    std::vector<i64> wlo, wlen, hkey, hid, hts;
+    std::vector<i64> wlo, wlen, hkey, hid, hts, hpm;
     i64 pend_rows = 0;
 
     i64 KP = 0, cap = 0;              // current ring geometry
@@ -251,6 +255,7 @@ struct Core {
             hkey.push_back(key);
             hid.push_back(rid);
             hts.push_back(out_ts);
+            hpm.push_back(hi > lo ? p[hi - 1] : 0);
             if (!eos) st.purge_pos = std::max(st.purge_pos, s_abs);
         }
     }
@@ -424,6 +429,7 @@ struct Core {
         L.hkey = std::move(hkey);
         L.hid = std::move(hid);
         L.hts = std::move(hts);
+        L.hpmax = std::move(hpm);
         L.K = K; L.R = Rr; L.B = B; L.KP = KP; L.cap = cap;
         L.rebase = rebase ? 1 : 0;
         {
@@ -434,7 +440,7 @@ struct Core {
         for (auto &st : keys) st.purge();
         pend_rows = 0;
         wrow.clear(); wlo.clear(); wlen.clear();
-        hkey = {}; hid = {}; hts = {};
+        hkey = {}; hid = {}; hts = {}; hpm = {};
     }
 
     // Bulk path for key-PERIODIC in-order chunks — the shape every
@@ -1060,6 +1066,7 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     cat64(A.hid, B.hid);
     cat64(A.hts, B.hts);
     cat64(A.hlen, B.hlen);
+    cat64(A.hpmax, B.hpmax);
     A.blk = std::move(nblk);
     A.offs = std::move(noffs);
     A.rows = std::move(nrows);
@@ -1165,7 +1172,7 @@ void wf_launch_take_regular(void *h, int32_t *rcount, int32_t *rstart0,
 static void take_common(Launch &L, void *blk, i64 rows_pad,
                         i64 cols_pad, i64 *offs, int32_t *wrows,
                         int32_t *wstarts, int32_t *wlens, i64 *hkey,
-                        i64 *hid, i64 *hts, i64 *hlen) {
+                        i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax) {
     const i64 isz = 1LL << L.wire;
     if (rows_pad <= 0) {
         std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));
@@ -1192,6 +1199,8 @@ static void take_common(Launch &L, void *blk, i64 rows_pad,
         std::memcpy(hid, L.hid.data(), (size_t)L.B * 8);
         std::memcpy(hts, L.hts.data(), (size_t)L.B * 8);
         std::memcpy(hlen, L.hlen.data(), (size_t)L.B * 8);
+        // callers with no host-side position-max stats pass null
+        if (hpmax) std::memcpy(hpmax, L.hpmax.data(), (size_t)L.B * 8);
     }
 }
 
@@ -1210,7 +1219,7 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
     Core *c = (Core *)h;
     Launch L = pop_front(c);
     take_common(L, blk, 0, 0, offs, wrows, wstarts, wlens,
-                hkey, hid, hts, hlen);
+                hkey, hid, hts, hlen, nullptr);
 }
 
 // wf_launch_take writing blk into a zero-padded (rows_pad, cols_pad)
@@ -1219,11 +1228,11 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
 void wf_launch_take_padded(void *h, void *blk, i64 rows_pad, i64 cols_pad,
                            i64 *offs, int32_t *wrows, int32_t *wstarts,
                            int32_t *wlens, i64 *hkey, i64 *hid, i64 *hts,
-                           i64 *hlen) {
+                           i64 *hlen, i64 *hpmax) {
     Core *c = (Core *)h;
     Launch L = pop_front(c);
     take_common(L, blk, rows_pad, cols_pad, offs, wrows, wstarts, wlens,
-                hkey, hid, hts, hlen);
+                hkey, hid, hts, hlen, hpmax);
 }
 
 }  // extern "C"

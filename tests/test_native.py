@@ -574,3 +574,56 @@ def test_prewarm_regular_ladder_covers_merged_shapes():
             assert sk in R._STEP_CACHE, f"ladder sibling missing: {sk}"
     # idempotent: a second call has nothing left to do
     assert R.prewarm_regular_ladder() == 0
+
+
+def test_native_multistat_pos_max_split():
+    """r3: a MultiReducer with one device-worthy stat rides the native
+    core — counts from window lengths, MAX(position) from the C++
+    archive's per-window last row (hpmax), sum shipped — and matches the
+    host core field-for-field on both TB and CB windows."""
+    from windflow_tpu.ops.functions import MultiReducer
+
+    def agg():
+        return MultiReducer(("count", None, "n"), ("max", "ts", "hi"),
+                            ("sum", "value", "sm"))
+
+    # TB: position field is ts -> max(ts) is the pos-max part
+    spec = WindowSpec(50, 50, WinType.TB)
+    rng = np.random.default_rng(17)
+    nk, per = 3, 400
+    batches = []
+    for lo in range(0, per, 61):
+        m = min(61, per - lo)
+        batches.append(batch_from_columns(
+            SCHEMA, key=np.tile(np.arange(nk), m),
+            id=np.repeat(np.arange(lo, lo + m), nk),
+            ts=np.repeat(np.arange(lo, lo + m) * 7, nk),
+            value=rng.integers(-50, 100, size=m * nk).astype(np.int64)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, agg(), batch_len=32, flush_rows=100)
+    assert isinstance(core, NativeResidentCore)
+    assert [p.out_field for p in core._pos_max_parts] == ["hi"]
+    host = run_core(WinSeqCore(spec, agg()), batches)
+    got = run_core(core, batches)
+    assert len(host) == len(got)
+    for f in ("key", "id", "ts", "n", "hi", "sm"):
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+
+    # CB sliding: regular-descriptor launches must carry hpmax too
+    spec = WindowSpec(16, 4, WinType.CB)
+    cb_agg = MultiReducer(("count", None, "n"), ("max", "id", "hi"),
+                          ("sum", "value", "sm"))
+    batches = cb_stream(4, 900, chunk=128, seed=23)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, cb_agg, batch_len=1 << 20,
+                             flush_rows=200)
+    assert isinstance(core, NativeResidentCore)
+    host = run_core(WinSeqCore(spec, MultiReducer(
+        ("count", None, "n"), ("max", "id", "hi"),
+        ("sum", "value", "sm"))), batches)
+    got = run_core(core, batches)
+    assert len(host) == len(got)
+    for f in ("key", "id", "ts", "n", "hi", "sm"):
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)

@@ -554,3 +554,53 @@ def test_acc_dtype_warning_gated_on_value_range():
     assert fires(Reducer("sum", value_range=(0, 100)), tb)
     # declared range too wide for the window length -> warn
     assert fires(Reducer("sum", value_range=(0, 2 ** 40)), spec)
+
+
+def test_pos_max_split_ships_single_column():
+    """r3: COUNT + MAX(ts) + SUM(revenue) over TB windows must ship ONLY
+    the revenue column — max-ts is free from the ts-ordered archive, so
+    the executor is the single-field ring, not multi-field — and results
+    must match the host core."""
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.ops.resident import (MultiFieldResidentExecutor,
+                                           ResidentWindowExecutor)
+
+    schema = Schema(revenue=np.int64)
+    mk = MultiReducer(("count", None, "n"), ("max", "ts", "hi"),
+                      ("sum", "revenue", "rev"))
+    spec = WindowSpec(100, 100, WinType.TB)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mk)
+    assert isinstance(core, ResidentWinSeqCore)
+    assert isinstance(core.executor, ResidentWindowExecutor)
+    assert not isinstance(core.executor, MultiFieldResidentExecutor)
+    assert core._ship_fields == ("revenue",)
+    assert [p.out_field for p in core._pos_max_parts] == ["hi"]
+
+    rng = np.random.default_rng(3)
+    nk, per = 4, 300
+    batches = []
+    for lo in range(0, per, 60):
+        m = min(60, per - lo)
+        ts = np.repeat(np.arange(lo, lo + m) * 7, nk)
+        batches.append(batch_from_columns(
+            schema, key=np.tile(np.arange(nk), m),
+            id=np.repeat(np.arange(lo, lo + m), nk), ts=ts,
+            revenue=rng.integers(1, 98, m * nk)))
+
+    def run(c):
+        outs = [c.process(b) for b in batches]
+        outs.append(c.flush())
+        outs = [o for o in outs if len(o)]
+        return np.sort(np.concatenate(outs), order=["key", "id"])
+
+    got = run(core)
+    want = run(WinSeqCore(spec, MultiReducer(
+        ("count", None, "n"), ("max", "ts", "hi"),
+        ("sum", "revenue", "rev"))))
+    assert len(got) == len(want)
+    for f in ("key", "id", "ts", "n", "hi", "rev"):
+        np.testing.assert_array_equal(got[f], want[f], err_msg=f)

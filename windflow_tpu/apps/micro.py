@@ -67,6 +67,8 @@ def run(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
                         parallelism=pardegree))
             .add(FlatMap(fm, SCHEMA, vectorized=True, parallelism=pardegree))
             .chain_sink(Sink(sink, vectorized=True)))
+    from ..ops import resident
+    resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
     pipe.run_and_wait_end()
     elapsed = time.perf_counter() - t0
@@ -76,6 +78,8 @@ def run(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
         "tuples_per_sec": round(sent[0] / elapsed, 1),
         "avg_latency_us": round(lat_sum[0] / max(rcv[0], 1), 1),
         "elapsed_sec": round(elapsed, 3),
+        # wire diagnostics (bench.py discipline; zeros: no device stage)
+        **resident.stats_snapshot(reset=True),
     }
 
 

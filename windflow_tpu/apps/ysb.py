@@ -297,6 +297,8 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
                                       pardegree2, win_sec, chunk,
                                       opt_level=opt_level,
                                       force_device=force_device)
+    from ..ops import resident
+    resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
     pipe.run_and_wait_end()
     elapsed = time.perf_counter() - t0
@@ -306,6 +308,10 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
         "avg_latency_us": round(sink.avg_latency_us, 1),
         "elapsed_sec": round(elapsed, 3),
         "events_per_sec": round(sent[0] / elapsed, 1),
+        # wire diagnostics (bench.py discipline): zeros on host-only
+        # variants; on device variants they separate wire weather from
+        # framework regressions
+        **resident.stats_snapshot(reset=True),
     }
 
 

@@ -92,7 +92,7 @@ def _bind(lib):
                                    p_i64, p_i64, p_i64, p_i64]
     lib.wf_launch_take_padded.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, i64, i64,
-        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64]
+        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64, p_i64]
     lib.wf_launch_peek_regular.restype = ctypes.c_int
     lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
     lib.wf_launch_coalesce.restype = i64

@@ -222,12 +222,6 @@ class MultiReducer(WindowFunction, WindowUpdate):
     def count_parts(self):
         return [p for p in self.parts if p.op == "count"]
 
-    def resident_field(self):
-        """The single shipped column when every device stat reads the same
-        field (the resident path's requirement); None otherwise."""
-        fields = {p.field for p in self.device_parts}
-        return fields.pop() if len(fields) == 1 else None
-
     # --- NIC ---
     def apply(self, key, gwid, rows):
         return tuple(v for p in self.parts for v in p.apply(key, gwid, rows))

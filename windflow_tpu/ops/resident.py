@@ -48,6 +48,8 @@ from .monoid import identity as _identity
 #: process-wide compiled-step cache (executors are per-pattern-instance,
 #: the executables they compile should outlive them)
 _STEP_CACHE = {}
+#: step-cache keys added by prewarm_regular_ladder (never seed ladders)
+_PREWARMED = set()
 
 # -- wire diagnostics (always on: one lock round-trip per dispatch) ---------
 # The bench's artifact of record must distinguish a weather-trashed capture
@@ -789,6 +791,12 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
     devices = list(devices) if devices else [jax.devices()[0]]
     warmed = 0
     for key in list(_STEP_CACHE):
+        if key in _PREWARMED:
+            # a prewarmed sibling never seeds further ladders: the buddy
+            # multiplicity caps at 16x of a NATURAL launch shape, so
+            # ladders-of-ladders are undispatchable (and repeat calls
+            # must be no-ops)
+            continue
         tag = key[0] if isinstance(key, tuple) and key else None
         if tag == "reg":
             _t, op, cap, Rb, KP, C, blk_dt, acc_dt, slide = key
@@ -819,8 +827,11 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
                       acc_dt, slide, mesh, axis)
             if sk in _STEP_CACHE:
                 continue
+            # cache only AFTER the warm dispatch succeeds: a transient
+            # wire error mid-warm must leave the key retryable, not
+            # "warm" with a cold executable behind it
             if mesh is None:
-                fn = _STEP_CACHE[sk] = _make_regular_step(sk)
+                fn = _make_regular_step(sk)
                 for dev in devices:
                     ring = jax.device_put(
                         jnp.zeros((KP, cap), dtype=np.dtype(acc_dt)), dev)
@@ -832,7 +843,7 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
                     jax.block_until_ready(out)
             else:
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                fn = _STEP_CACHE[sk] = _make_mesh_regular_step(sk)
+                fn = _make_mesh_regular_step(sk)
                 s2 = NamedSharding(mesh, P(axis, None))
                 s1 = NamedSharding(mesh, P(axis))
                 ring = jax.device_put(
@@ -842,5 +853,7 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
                 zi = jax.device_put(jnp.zeros(KP, dtype=np.int32), s1)
                 _ring2, out = fn(ring, blk, zi, zi, zi, zi)
                 jax.block_until_ready(out)
+            _STEP_CACHE[sk] = fn
+            _PREWARMED.add(sk)
             warmed += 1
     return warmed

@@ -63,12 +63,33 @@ class NativeResidentCore:
         self._lib = load()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
-        if not isinstance(reducer, Reducer):
-            raise TypeError("native resident core needs a builtin Reducer")
+        from ..ops.functions import MultiReducer
+        if isinstance(reducer, MultiReducer):
+            # multi-stat with exactly ONE device-worthy stat: counts come
+            # from window lengths and MAX over the position field from the
+            # C++ archive's per-window last row (hpmax) — e.g. YSB's
+            # COUNT + MAX(ts) + SUM(revenue) ships only revenue while the
+            # whole hot loop stays in C++
+            from .win_seq_tpu import split_pos_max
+            dev, pos = split_pos_max(spec, reducer)
+            if len(dev) != 1:
+                raise TypeError(
+                    "native resident core needs exactly one device-worthy "
+                    f"stat (got {len(dev)} after the pos-max split)")
+            self._dev_part = dev[0]
+            self._pos_max_parts = pos
+            self._count_parts = reducer.count_parts
+        elif isinstance(reducer, Reducer):
+            self._dev_part = reducer
+            self._pos_max_parts = []
+            self._count_parts = []
+        else:
+            raise TypeError("native resident core needs a builtin "
+                            "(Multi)Reducer")
         self.spec = spec
         self.reducer = reducer
-        self.field = reducer.field
-        self.out_field = reducer.out_field
+        self.field = self._dev_part.field
+        self.out_field = self._dev_part.out_field
         self.config = config or PatternConfig.plain(spec.slide_len)
         self.role = role
         self.map_indexes = map_indexes
@@ -87,7 +108,7 @@ class NativeResidentCore:
                             else max_delay_ms / 1e3)
         self._last_flush_t = None
         from .win_seq_tpu import resolve_worker_device, select_acc_dtype
-        acc = select_acc_dtype(reducer, compute_dtype, spec)
+        acc = select_acc_dtype(self._dev_part, compute_dtype, spec)
         # key-sharded multithreading: shard t owns keys with
         # mix64(key) %% S == t (a hash decorrelated from the farm routing
         # modulus — see wf_native.cpp), each with an independent sub-core,
@@ -108,11 +129,11 @@ class NativeResidentCore:
             # exactly the multi-chip path)
             self.shards = 1
             self.executors = [MeshResidentExecutor(
-                reducer.op, mesh, depth=depth, acc_dtype=acc)]
+                self._dev_part.op, mesh, depth=depth, acc_dtype=acc)]
         else:
             self.executors = [
                 ResidentWindowExecutor(
-                    reducer.op,
+                    self._dev_part.op,
                     device=resolve_worker_device(
                         device, worker_index * self.shards + t),
                     depth=depth, acc_dtype=acc)
@@ -394,11 +415,13 @@ class NativeResidentCore:
         hid = np.empty(max(B, 1), dtype=np.int64)
         hts = np.empty(max(B, 1), dtype=np.int64)
         hlen = np.empty(max(B, 1), dtype=np.int64)
+        hpm = (np.empty(max(B, 1), dtype=np.int64)
+               if self._pos_max_parts else None)
         p32 = ctypes.POINTER(ctypes.c_int32)
         p64 = ctypes.POINTER(ctypes.c_longlong)
         regular = False
         cmax = ctypes.c_longlong()
-        if (self.reducer.op == "sum"
+        if (self._dev_part.op == "sum"
                 and lib.wf_launch_peek_regular(handle, ctypes.byref(cmax))):
             regular = True
             rcount = np.empty(K, dtype=np.int32)
@@ -423,14 +446,16 @@ class NativeResidentCore:
                 offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
                 wstarts_p, wlens_p,
                 hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
-                hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64))
+                hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64),
+                hpm.ctypes.data_as(p64) if hpm is not None else None)
         if rebase.value:
             ex.reset(max(K, 1), cap.value)
         if getattr(ex, "mesh", None) is not None:
             # the mesh executor re-scatters rows onto its own (shard-
             # rounded) KP; hand it the live rows only, not the C++ padding
             blk = blk[:K]
-        meta = (hkey[:B], hid[:B], hts[:B], hlen[:B])
+        meta = (hkey[:B], hid[:B], hts[:B], hlen[:B],
+                hpm[:B] if hpm is not None else None)
         if regular:
             # per-key arithmetic descriptors instead of 3x B int32 arrays
             ex.launch_regular(meta, blk, offs, rcount, rstart0, rlen,
@@ -445,12 +470,17 @@ class NativeResidentCore:
             return np.zeros(0, dtype=self._result_dtype)
         from .win_seq_tpu import finalize_window_values
         outs = []
-        for (hkey, hid, hts, hlen), out in harvested:
+        for (hkey, hid, hts, hlen, hpm), out in harvested:
             res = np.zeros(len(out), dtype=self._result_dtype)
             res["key"] = hkey
             res["id"] = hid
             res["ts"] = hts
-            res[self.out_field] = finalize_window_values(self.reducer, out,
-                                                         hlen)
+            res[self.out_field] = finalize_window_values(self._dev_part,
+                                                         out, hlen)
+            for part in self._count_parts:
+                res[part.out_field] = hlen.astype(part.dtype)
+            for part in self._pos_max_parts:
+                res[part.out_field] = finalize_window_values(part, hpm,
+                                                             hlen)
             outs.append(res)
         return outs[0] if len(outs) == 1 else np.concatenate(outs)

@@ -317,6 +317,7 @@ class ResidentWinSeqCore(WinSeqCore):
                                     MultiFieldResidentExecutor,
                                     ResidentWindowExecutor)
         self._jax_fn = None
+        self._pos_max_parts = []
         if isinstance(reducer, JaxWindowFunction):
             # arbitrary batched JAX window fn over device-resident rings —
             # one ring per input field (win_seq_gpu.hpp:54-67's arbitrary
@@ -326,11 +327,27 @@ class ResidentWinSeqCore(WinSeqCore):
             self._jax_fn = reducer
             field = None
         elif isinstance(reducer, MultiReducer):
-            # multi-stat: every non-count stat evaluates over its field's
-            # resident ring in one fused dispatch; counts come free
-            self._device_parts = reducer.device_parts
+            # multi-stat: every DEVICE-WORTHY stat evaluates over its
+            # field's resident ring in one fused dispatch; counts come
+            # free from window lengths, and MAX over the POSITION field
+            # (ts for TB, id for CB) is free from the position-ordered
+            # host archive (stream_archive.hpp ordering) — splitting it
+            # out here means e.g. YSB's COUNT + MAX(ts) + SUM(revenue)
+            # ships ONLY the revenue column (narrowed to int8 on the
+            # wire), not ts
+            self._device_parts, self._pos_max_parts = \
+                split_pos_max(spec, reducer)
             self._count_parts = reducer.count_parts
-            field = reducer.resident_field()  # None => multi-field rings
+            if not self._device_parts:
+                # an entirely host-free aggregate forced onto the device
+                # (use_resident=True, wire benchmarking): ship the
+                # position column after all — there is nothing else to
+                # evaluate (make_core_for routes such aggregates to the
+                # host core unless forced)
+                self._device_parts, self._pos_max_parts = \
+                    self._pos_max_parts, []
+            fields = {p.field for p in self._device_parts}
+            field = fields.pop() if len(fields) == 1 else None
             if not self._device_parts:
                 raise ValueError(
                     "resident MultiReducer needs >=1 non-count stat "
@@ -465,7 +482,14 @@ class ResidentWinSeqCore(WinSeqCore):
         live_start = self._appended.get(key, 0) - len(p)
         self._wdesc.append((key, lo + live_start, (hi - lo).astype(np.int64),
                             gwids))
-        self._hdr.append((key, ids, ts, (hi - lo).astype(np.int64)))
+        if self._pos_max_parts and len(p):
+            # MAX over the position field, free from the ordered archive:
+            # the window's last row holds it (empty windows fixed up to
+            # the identity at harvest, finalize_window_values)
+            pm = p[np.minimum(np.maximum(hi - 1, 0), len(p) - 1)]
+        else:
+            pm = np.zeros(len(lwids), dtype=np.int64)
+        self._hdr.append((key, ids, ts, (hi - lo).astype(np.int64), pm))
         self._n_wins += len(lwids)
         if not eos and len(lwids):
             # defer the purge so a flush-time rebase can rebuild the ring
@@ -603,7 +627,7 @@ class ResidentWinSeqCore(WinSeqCore):
         for hdr, out in harvested:
             stat_arrs = out if isinstance(out, tuple) else (out,)
             off = 0
-            for key, ids, ts, lens in hdr:
+            for key, ids, ts, lens, pos_max in hdr:
                 n = len(ids)
                 payload = {}
                 i = 0
@@ -616,6 +640,9 @@ class ResidentWinSeqCore(WinSeqCore):
                     i += 1
                 for p in self._count_parts:
                     payload[p.out_field] = lens.astype(p.dtype)
+                for p in self._pos_max_parts:
+                    payload[p.out_field] = finalize_window_values(
+                        p, pos_max, lens)
                 outs.append(self._make_results(key, ids, ts, payload))
                 off += n
         return outs
@@ -653,6 +680,18 @@ class ResidentWinSeqCore(WinSeqCore):
 #: max over the position field; arbitrary JAX fns default to the
 #: segment-restaging executor and opt into resident rings)
 _RESIDENT_OPS = ("sum", "min", "max", "prod")
+
+
+def split_pos_max(spec: WindowSpec, reducer: MultiReducer):
+    """Partition a MultiReducer's non-count stats into (device_parts,
+    pos_max_parts): MAX over the POSITION field (ts for TB, id for CB) is
+    free from the position-ordered archive — the window's last row holds
+    it — so it never needs to ship (e.g. YSB's COUNT + MAX(ts) +
+    SUM(revenue) ships only the revenue column)."""
+    pos_field = "id" if spec.win_type is WinType.CB else "ts"
+    dev = reducer.device_parts
+    pos = [p for p in dev if p.op == "max" and p.field == pos_field]
+    return [p for p in dev if p not in pos], pos
 
 
 def _host_free(spec: WindowSpec, winfunc) -> bool:
@@ -729,6 +768,20 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                 "MultiReducer runs on the resident device path only: "
                 "needs >=1 non-count stat, ops in "
                 f"{_RESIDENT_OPS}, no float sum (got {winfunc.parts})")
+        dev_parts, _pos = split_pos_max(spec, winfunc)
+        from ..native import enabled
+        if mesh is None and len(dev_parts) == 1 and enabled() is not None:
+            # exactly one stat needs the device after the pos-max split
+            # (counts and max-over-position are answered host-side): the
+            # C++ core carries the whole hot loop and ships one column
+            from .native_core import NativeResidentCore
+            return NativeResidentCore(
+                spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
+                config=config, role=role, map_indexes=map_indexes,
+                result_ts_slide=result_ts_slide, device=device,
+                depth=depth if depth is not None else 8,
+                compute_dtype=compute_dtype, shards=shards,
+                worker_index=worker_index, max_delay_ms=max_delay_ms)
         return ResidentWinSeqCore(
             spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
             config=config, role=role, map_indexes=map_indexes,
