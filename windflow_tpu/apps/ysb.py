@@ -279,6 +279,14 @@ def warmup(variant, pardegree1, pardegree2, win_sec, chunk,
                                 win_sec, chunk, batches=batches,
                                 force_device=force_device)
     pipe.run_and_wait_end()
+    if variant.endswith("-tpu"):
+        # the coalescing shape ladder: merged TB dispatch buckets only
+        # occur under wire stall, when a cold compile hurts most
+        import jax
+        from ..ops import resident
+        devs = jax.devices()
+        resident.prewarm_regular_ladder(devices=list(dict.fromkeys(
+            devs[i % len(devs)] for i in range(pardegree2))))
 
 
 def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,

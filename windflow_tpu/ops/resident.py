@@ -804,6 +804,14 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
         elif tag == "mesh-reg":
             (_t, op, cap, Rb, KP, C, blk_dt, acc_dt, slide, mesh,
              axis) = key
+        elif isinstance(tag, tuple) and len(key) == 8:
+            # plain (irregular-descriptor) step: TB windows and non-sum
+            # ops merge on explicit descriptors, so their ladder siblings
+            # double both the rectangle AND the window-count bucket.
+            # (multi-field keys are also tuple-tagged but 10-long — their
+            # executor is Python-core only, which never coalesces)
+            _ops, cap, Rb, Bb, KP, blk_dt, acc_dt, pad = key
+            mesh = axis = None
         else:
             continue
         for m in mults:
@@ -819,7 +827,9 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
             # builds and compiles cold mid-run
             if (KP // 2 + 1) * Rb * m > max_cells:
                 continue
-            if mesh is None:
+            if isinstance(tag, tuple):
+                sk = (tag, cap, Rb * m, Bb * m, KP, blk_dt, acc_dt, pad)
+            elif mesh is None:
                 sk = ("reg", op, cap, Rb * m, KP, C * m, blk_dt, acc_dt,
                       slide)
             else:
@@ -830,7 +840,20 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
             # cache only AFTER the warm dispatch succeeds: a transient
             # wire error mid-warm must leave the key retryable, not
             # "warm" with a cold executable behind it
-            if mesh is None:
+            if isinstance(tag, tuple):
+                fn = _make_step(sk)
+                for dev in devices:
+                    ring = jax.device_put(
+                        jnp.zeros((KP, cap), dtype=np.dtype(acc_dt)), dev)
+                    blk = jax.device_put(
+                        jnp.zeros((KP, Rb * m), dtype=np.dtype(blk_dt)),
+                        dev)
+                    zk = jax.device_put(jnp.zeros(KP, dtype=np.int32), dev)
+                    zb = jax.device_put(jnp.zeros(Bb * m, dtype=np.int32),
+                                        dev)
+                    _ring2, out = fn(ring, blk, zk, zb, zb, zb)
+                    jax.block_until_ready(out)
+            elif mesh is None:
                 fn = _make_regular_step(sk)
                 for dev in devices:
                     ring = jax.device_put(

@@ -1,0 +1,167 @@
+"""Cross-process row channels: the multi-host data plane.
+
+The reference's "communication backend" is FastFlow shared-memory queues
+between threads of ONE process (SURVEY.md §2.8 — no sockets, no MPI).
+The multi-host deployment model (parallel/multihost.py) keeps key groups
+process-local so the common case ships nothing — but a source whose input
+is NOT naturally key-partitioned (a socket feed, a file) must be able to
+forward rows to the process that owns their kf group.  This module is
+that hop: a typed, length-framed, batched TCP channel carrying the same
+SoA batches the in-process engine queues carry, so a remote stage slots
+into a pipeline exactly like a local one.
+
+Design notes (DCN-analog, deliberately boring):
+
+* batches cross as raw structured-array bytes with an 8-byte length
+  frame; the dtype travels once per connection (pickled — the channel
+  trusts its cluster, exactly like NCCL/MPI transports do);
+* one receiver accepts any number of senders; per-connection reader
+  threads feed one bounded queue, preserving per-sender batch order
+  (cross-sender order is interleaved, as with any multi-producer edge —
+  an OrderingNode downstream restores it where required);
+* EOS is an empty frame per sender; ``batches()`` ends when every
+  registered sender has closed — the FastFlow EOS cascade, one level up.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+
+import numpy as np
+
+_LEN = struct.Struct("<q")
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("row channel peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RowSender:
+    """Client end: ships structured-array batches to a RowReceiver."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._dtype_sent = None
+
+    def send(self, batch: np.ndarray):
+        if len(batch) == 0:
+            return
+        if self._dtype_sent is None:
+            d = pickle.dumps(batch.dtype)
+            self._sock.sendall(_LEN.pack(len(d)) + d)
+            self._dtype_sent = batch.dtype
+        elif batch.dtype != self._dtype_sent:
+            raise TypeError(
+                f"row channel dtype changed mid-stream: {self._dtype_sent}"
+                f" -> {batch.dtype}")
+        payload = np.ascontiguousarray(batch).tobytes()
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def close(self):
+        """Signal EOS (empty frame) and close the socket."""
+        try:
+            if self._dtype_sent is None:
+                # dtype never sent: ship a placeholder so the receiver's
+                # framing stays uniform (empty dtype, then EOS)
+                d = pickle.dumps(None)
+                self._sock.sendall(_LEN.pack(len(d)) + d)
+            self._sock.sendall(_LEN.pack(-1))
+        finally:
+            self._sock.close()
+
+
+class RowReceiver:
+    """Server end: accepts ``n_senders`` connections and yields their
+    batches until every sender closes."""
+
+    def __init__(self, n_senders: int, host: str = "127.0.0.1",
+                 port: int = 0, capacity: int = 64):
+        self.n_senders = int(n_senders)
+        self._srv = socket.create_server((host, port),
+                                         backlog=self.n_senders)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._q = queue.Queue(maxsize=capacity)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True,
+                                               name="wf-rowrecv-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        readers = []
+        try:
+            for _ in range(self.n_senders):
+                conn, _addr = self._srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                t = threading.Thread(target=self._read_loop, args=(conn,),
+                                     daemon=True, name="wf-rowrecv")
+                t.start()
+                readers.append(t)
+        except OSError:
+            pass  # server closed while accepting: senders never came
+        finally:
+            self._srv.close()
+
+    def _read_loop(self, conn: socket.socket):
+        try:
+            n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+            dtype = pickle.loads(_read_exact(conn, n))
+            while True:
+                n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                if n < 0:
+                    break
+                raw = _read_exact(conn, n)
+                self._q.put(np.frombuffer(raw, dtype=dtype).copy())
+        except (ConnectionError, OSError) as e:
+            self._q.put(e)
+        finally:
+            conn.close()
+            self._q.put(None)   # this sender is done
+
+    def batches(self):
+        """Yield batches until every sender has sent EOS; raises if any
+        connection died mid-stream (fail fast — a silently truncated
+        stream would produce silently wrong window totals)."""
+        done = 0
+        while done < self.n_senders:
+            item = self._q.get()
+            if item is None:
+                done += 1
+            elif isinstance(item, Exception):
+                raise item
+            else:
+                yield item
+
+
+def partition_and_ship(batch: np.ndarray, owners: np.ndarray, my_pid: int,
+                       senders: dict) -> np.ndarray:
+    """Split one batch by owning process (``owners`` from
+    ``multihost.process_for_keys``): rows owned here are returned for
+    local processing; every other process's rows go out through its
+    ``senders[pid]`` RowSender.  The one-call form of the multi-host
+    source contract for non-key-partitioned inputs."""
+    mine = batch[owners == my_pid]
+    covered = np.isin(owners, [my_pid, *senders])
+    if not covered.all():
+        # fail fast: a pid with rows but no sender would silently truncate
+        # the stream (and so silently corrupt window totals downstream)
+        missing = sorted(set(np.asarray(owners)[~covered].tolist()))
+        raise KeyError(f"rows owned by process(es) {missing} but no "
+                       "RowSender registered for them")
+    for pid, snd in senders.items():
+        if pid == my_pid:
+            continue
+        part = batch[owners == pid]
+        if len(part):
+            snd.send(part)
+    return mine
