@@ -1012,6 +1012,22 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     // the Python-side overflow guard is offs.max() + bucket(R) <= cap;
     // respect the same conservative bound so a merged launch never trips it
     if (maxoff + bucket(newR) > A.cap) return false;
+    if (!regular) {
+        // irregular dispatch shapes are keyed on (bucket(R), bucket(B)):
+        // keep merged shapes on the DIAGONAL ladder of the pair's base
+        // shape — equal buckets in, proportional buckets out — so the
+        // prewarmed {2x..16x} siblings cover every reachable shape and a
+        // merge can never manufacture an off-diagonal bucket that
+        // compiles cold mid-stall (the exact failure the prewarm exists
+        // to prevent).  Rejected pairs simply stay unmerged.
+        if (bucket(A.R) != bucket(B.R)
+            || bucket(std::max<i64>(A.B, 1)) != bucket(std::max<i64>(B.B, 1)))
+            return false;
+        const i64 rr = bucket(newR) / bucket(A.R);
+        const i64 rb2 = bucket(std::max<i64>(A.B + B.B, 1))
+                        / bucket(std::max<i64>(A.B, 1));
+        if (rr != rb2) return false;
+    }
     const int wire2 = std::max(A.wire, B.wire);
     const i64 isz2 = 1LL << wire2;
     std::vector<u8> nblk((size_t)(K2 * newR * isz2), 0);

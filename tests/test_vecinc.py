@@ -152,8 +152,9 @@ def test_vec_core_gate():
                                   Reducer("sum"))         # W > 64
     assert isinstance(WinSeq(Reducer("sum"), 4, 4, WinType.CB).make_core(),
                       VecIncTumblingCore)
+    from windflow_tpu.core.vecinc import LazySlidingCore
     assert isinstance(WinSeq(Reducer("sum"), 8, 4, WinType.CB).make_core(),
-                      VecIncSlidingCore)
+                      LazySlidingCore)
     assert isinstance(WinSeq(Reducer("sum"), 4, 8, WinType.CB).make_core(),
                       WinSeqCore)
 
@@ -281,3 +282,31 @@ def test_vec_sliding_high_cardinality_budget():
     want = run_core(WinSeqCore(spec, red).use_incremental(), sub)
     got_sub = got[np.isin(got["key"], sample)]
     assert_equivalent(got_sub, want)
+
+
+def test_lazy_sliding_core_picks_by_cardinality():
+    """Sliding windows defer the core choice to the first chunk: few
+    distinct keys -> the per-key-group WinSeqCore (faster below the
+    crossover), many -> the lane-vectorised core; results identical
+    either way."""
+    from windflow_tpu.core.vecinc import LazySlidingCore, VecIncSlidingCore
+    spec = WindowSpec(8, 4, WinType.CB)
+
+    def stream(n_keys):
+        ids = np.repeat(np.arange(40), n_keys)
+        keys = np.tile(np.arange(n_keys), 40)
+        return [batch_from_columns(SCHEMA, key=keys, id=ids, ts=ids,
+                                   value=ids + keys % 7)]
+
+    small = LazySlidingCore(spec, Reducer("sum"))
+    got_small = run_core(small, stream(10))
+    assert isinstance(small._core, WinSeqCore)
+    big = LazySlidingCore(spec, Reducer("sum"), threshold=16)
+    got_big = run_core(big, stream(32))
+    assert isinstance(big._core, VecIncSlidingCore)
+    want_small = run_core(WinSeqCore(spec, Reducer("sum")).use_incremental(),
+                          stream(10))
+    assert_equivalent(got_small, want_small)
+    want_big = run_core(WinSeqCore(spec, Reducer("sum")).use_incremental(),
+                        stream(32))
+    assert_equivalent(got_big, want_big)
