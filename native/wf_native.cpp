@@ -983,11 +983,19 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     // instead.)
     if (max_mult > kCoalesceLadderMax) max_mult = kCoalesceLadderMax;
     if (A.mult != B.mult || A.mult * 2 > max_mult) return false;
+    // only LIKE pairs merge: two regular launches stay compressed, two
+    // irregular launches concatenate explicit descriptors (their base
+    // plain-step shapes are in the compile cache, so the merged diagonal
+    // sibling is prewarmed).  A mixed pair — or a regular pair whose
+    // window sequences broke continuity — would have to dispatch an
+    // irregular shape that NO prior launch compiled, handing the run the
+    // very cold mid-stall compile coalescing exists to avoid: reject.
+    if (A.regular != B.regular) return false;
+    const bool regular = A.regular != 0;
     const i64 K2 = std::max(A.K, B.K);
     // per-key row continuity (B's rows must land right after A's in the
     // ring for B's descriptors to stay valid — true by construction for
     // adjacent flushes, verified here), regularity continuity, width
-    bool regular = A.regular && B.regular;
     i64 newR = 1, maxoff = 0;
     for (i64 k = 0; k < K2; ++k) {
         const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
@@ -1002,7 +1010,7 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
                 && (B.rlen[(size_t)k] != A.rlen[(size_t)k]
                     || B.rstart0[(size_t)k]
                            != A.rstart0[(size_t)k] + (int32_t)(ca * slide)))
-                regular = false;   // merge anyway, explicit descriptors
+                return false;
         }
         newR = std::max(newR, ra + rb);
         maxoff = std::max(maxoff,
@@ -1138,12 +1146,12 @@ i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge,
         Launch A, B;
         {
             std::lock_guard<std::mutex> lk(c->qmu);
-            // find the next adjacent candidate pair at or after i
-            // (regularity is NOT required: irregular/TB launches merge
-            // on their explicit descriptors)
+            // find the next adjacent candidate pair at or after i (LIKE
+            // pairs only: regular+regular compressed, irregular+irregular
+            // on explicit descriptors)
             while (i + 1 < c->queue.size()) {
                 Launch &a = c->queue[i], &b = c->queue[i + 1];
-                if (!a.rebase && !b.rebase
+                if (!a.rebase && !b.rebase && a.regular == b.regular
                     && a.mult == b.mult && a.mult * 2 <= mcap)
                     break;
                 ++i;

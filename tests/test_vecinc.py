@@ -310,3 +310,45 @@ def test_lazy_sliding_core_picks_by_cardinality():
     want_big = run_core(WinSeqCore(spec, Reducer("sum")).use_incremental(),
                         stream(32))
     assert_equivalent(got_big, want_big)
+
+
+@pytest.mark.parametrize("op", ["sum", "max", "count"])
+@pytest.mark.parametrize("wt", [WinType.CB, WinType.TB])
+def test_lazy_sliding_core_escalates_mid_stream(wt, op):
+    """A key-clustered stream (first chunks carry few keys) must not lock
+    the lazy selector into the per-key core: when observed cardinality
+    crosses the threshold, the per-key state migrates into the lane core
+    mid-stream, with results identical to the reference oracle."""
+    from windflow_tpu.core.vecinc import LazySlidingCore, VecIncSlidingCore
+    spec = WindowSpec(9, 4, wt)
+    rng = np.random.default_rng(57)
+    n_keys = 40
+
+    def clustered():
+        chunks = []
+        # phase 1: two keys only, long runs (under-represents the set)
+        for lo in range(0, 30, 10):
+            ids = np.repeat(np.arange(lo, lo + 10), 2)
+            keys = np.tile(np.arange(2), 10)
+            chunks.append(batch_from_columns(
+                SCHEMA, key=keys, id=ids, ts=ids * 3 + keys,
+                value=rng.integers(-5, 50, 20)))
+        # phase 2: every key arrives (ids resume mid-stream per key)
+        for lo in range(0, 40, 8):
+            ids = np.repeat(np.arange(lo, lo + 8), n_keys)
+            keys = np.tile(np.arange(n_keys), 8)
+            # keys 0/1 continue beyond their phase-1 ids
+            ids = np.where(keys < 2, ids + 30, ids)
+            chunks.append(batch_from_columns(
+                SCHEMA, key=keys, id=ids, ts=ids * 3 + keys,
+                value=rng.integers(-5, 50, 8 * n_keys)))
+        return chunks
+
+    chunks = clustered()
+    red = Reducer(op, out_field="r")
+    lazy = LazySlidingCore(spec, Reducer(op, out_field="r"), threshold=16)
+    got = run_core(lazy, chunks)
+    assert isinstance(lazy._core, VecIncSlidingCore), \
+        "selector never escalated despite crossing the threshold"
+    want = run_core(WinSeqCore(spec, red).use_incremental(), chunks)
+    assert_equivalent(got, want)

@@ -593,15 +593,18 @@ class VecIncSlidingCore(VecIncTumblingCore):
 
 
 class LazySlidingCore:
-    """Defers the sliding-core choice to the first chunk's key
-    cardinality: the per-key-group ``WinSeqCore`` wins below ~512
-    distinct keys, the lane-vectorised ``VecIncSlidingCore`` above
-    (measured crossover between 256 and 1024 keys on the 1-core bench
-    host — 64 keys: 2.9M vs 1.6M tps; 16k keys: 0.24M vs 4.0M).  The
-    first chunk's distinct-key count is the cardinality proxy (a chunk
-    covers the whole key set for every benchmark-shaped stream);
-    mispredictions cost only throughput, never correctness — both cores
-    are differentially identical."""
+    """Defers the sliding-core choice to observed key cardinality: the
+    per-key-group ``WinSeqCore`` wins below ~512 distinct keys, the
+    lane-vectorised ``VecIncSlidingCore`` above (measured crossover
+    between 256 and 1024 keys on the 1-core bench host — 64 keys: 2.9M
+    vs 1.6M tps; 16k keys: 0.24M vs 4.0M).  The first chunk picks the
+    initial core; if a key-clustered stream later crosses the threshold
+    (e.g. per-key-partitioned replay whose first chunk carries few
+    keys), the per-key core's state MIGRATES into the lane core — its
+    NIC archives hold exactly the live rows the open-window lanes need —
+    so the choice is never locked in.  Mispredictions cost only
+    throughput, never correctness: both cores are differentially
+    identical."""
 
     def __init__(self, spec: WindowSpec, winfunc, threshold: int = 512,
                  **kw):
@@ -610,6 +613,7 @@ class LazySlidingCore:
         self._kw = kw
         self._threshold = threshold
         self._core = None
+        self._perkey = False
         self.result_schema = Schema(**winfunc.result_fields)
         self._result_dtype = self.result_schema.dtype()
         self.is_nic = False
@@ -622,7 +626,53 @@ class LazySlidingCore:
         else:
             from .winseq import WinSeqCore
             self._core = WinSeqCore(self.spec, self.winfunc, **self._kw)
+            self._perkey = True
         return self._core
+
+    def _escalate(self):
+        """Move the per-key core's live state into a fresh lane core:
+        per-key scalars copy across (the slot registration recomputes the
+        identical distribution math), and each open window's accumulator
+        folds from the archive range the NIC core kept live (purge only
+        ever runs below the last FIRED window's start, so open windows'
+        rows are all present)."""
+        old = self._core
+        vec = VecIncSlidingCore(self.spec, self.winfunc, **self._kw)
+        W = vec._W
+        spec = self.spec
+        if old._keys:
+            keys = np.fromiter(old._keys.keys(), dtype=np.int64,
+                               count=len(old._keys))
+            slots = vec._slots_for(keys)
+            for key, slot in zip(keys.tolist(), slots.tolist()):
+                st = old._keys[key]
+                vec._last_pos[slot] = st.last_pos
+                vec._nfired[slot] = st.n_fired
+                vec._ncreated[slot] = st.next_lwid
+                vec._seen[slot] = st.next_lwid > st.n_fired
+                vec._emit_ctr[slot] = st.emit_counter
+                vec._marker_pos[slot] = st.marker_pos
+                vec._marker_ts[slot] = st.marker_ts
+                p = st.archive.positions
+                rows = st.archive.rows
+                for lw in range(st.n_fired, st.next_lwid):
+                    lo = np.searchsorted(p, spec.win_start(lw)
+                                         + st.initial_id, side="left")
+                    hi = np.searchsorted(p, spec.win_end(lw)
+                                         + st.initial_id, side="left")
+                    if hi <= lo:
+                        continue
+                    lane = lw % W
+                    seg = rows[lo:hi]
+                    for (of, field, ufunc, dt, _ident) in vec._parts:
+                        if ufunc is None:
+                            vec._acc[of][slot, lane] = hi - lo
+                        else:
+                            vec._acc[of][slot, lane] = ufunc.reduce(
+                                seg[field].astype(dt, copy=False))
+                    vec._acc_ts[slot, lane] = int(seg["ts"][-1])
+        self._core = vec
+        self._perkey = False
 
     def process(self, batch):
         core = self._core
@@ -630,7 +680,10 @@ class LazySlidingCore:
             if len(batch) == 0:
                 return np.zeros(0, dtype=self._result_dtype)
             core = self._pick(batch)
-        return core.process(batch)
+        out = core.process(batch)
+        if self._perkey and len(core._keys) >= self._threshold:
+            self._escalate()
+        return out
 
     def flush(self):
         if self._core is None:
