@@ -56,6 +56,23 @@ def test_fuzz_host_core_selection(seed):
         WinSeq(Reducer(op, out_field="r"), win, slide, wt, config=cfg,
                role=role, map_indexes=mi).make_core(), chunks)
     assert_equivalent(got, oracle)
+    if slide < win:
+        # the drawn cardinalities sit below the lazy selector's default
+        # threshold, so force BOTH sliding-core regimes through the same
+        # config: the lane core directly, and the selector with a tiny
+        # threshold (escalation mid-stream when keys accumulate)
+        from windflow_tpu.core.vecinc import (LazySlidingCore,
+                                              VecIncSlidingCore)
+        if -(-win // slide) <= 64:
+            direct = run_core(
+                VecIncSlidingCore(spec, Reducer(op, out_field="r"),
+                                  config=cfg, role=role, map_indexes=mi),
+                chunks)
+            assert_equivalent(direct, oracle)
+            lazy = LazySlidingCore(spec, Reducer(op, out_field="r"),
+                                   threshold=4, config=cfg, role=role,
+                                   map_indexes=mi)
+            assert_equivalent(run_core(lazy, chunks), oracle)
 
 
 @pytest.mark.parametrize("seed", range(0, 16, 3))
@@ -81,3 +98,67 @@ def test_fuzz_device_cores(seed):
                           batch_len=32, flush_rows=96, use_resident=True),
             chunks)
     assert_equivalent(got, oracle)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_multireducer(seed):
+    """Multi-stat aggregates under random shapes: count + max + sum must
+    match the oracle through whatever core the selection picks — incl.
+    the pos-max split paths when the max targets the position field."""
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.patterns.win_seq import WinSeq
+    win, slide, wt, n_keys, _op, role, cfg, mi, skw = draw_config(seed)
+    rng = np.random.default_rng(3000 + seed)
+    chunks = make_stream(rng, n_keys, 4, 140, **skw)
+    spec = WindowSpec(win, slide, wt)
+    # alternate the max target between the position field (ts for TB,
+    # id for CB — host-free) and the value column (device-worthy)
+    max_field = ("ts" if wt is WinType.TB else "id") if seed % 2 \
+        else "value"
+
+    def agg():
+        return MultiReducer(("count", None, "n"), ("max", max_field, "mx"),
+                            ("sum", "value", "sm"))
+
+    oracle = run_core(WinSeqCore(spec, agg(), config=cfg, role=role,
+                                 map_indexes=mi), chunks)
+    got = run_core(WinSeq(agg(), win, slide, wt, config=cfg, role=role,
+                          map_indexes=mi).make_core(), chunks)
+    assert_equivalent(got, oracle)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_nested_farm_distribution(seed):
+    """Random farm distribution math: a WinFarm worker's private slide +
+    PatternConfig (the reference's modular gwid/initial_id arithmetic,
+    win_seq.hpp:307-314) against the plain sequential oracle via total
+    equality over every emitted window."""
+    from windflow_tpu.patterns.basic import Sink, Source
+    from windflow_tpu.patterns.win_farm import WinFarm
+    from windflow_tpu.patterns.win_seq import WinSeq
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+    from test_vecinc import SCHEMA
+    rng = np.random.default_rng(4000 + seed)
+    win = int(rng.integers(2, 16))
+    slide = int(rng.integers(1, win + 1))
+    wt = WinType.CB if seed % 2 else WinType.TB
+    deg = int(rng.integers(2, 5))
+    chunks = make_stream(rng, 9, 4, 150, gaps=bool(seed % 3 == 0))
+
+    def total(pattern):
+        acc = [0]
+
+        def snk(rows):
+            if rows is not None and len(rows):
+                acc[0] += int(rows["value"].sum())
+
+        df = Dataflow()
+        build_pipeline(df, [Source(batches=iter(chunks), schema=SCHEMA),
+                            pattern, Sink(snk, vectorized=True)])
+        df.run_and_wait_end()
+        return acc[0]
+
+    want = total(WinSeq(Reducer("sum"), win, slide, wt))
+    got = total(WinFarm(Reducer("sum"), win, slide, wt, pardegree=deg))
+    assert got == want, (win, slide, wt, deg)

@@ -352,3 +352,55 @@ def test_lazy_sliding_core_escalates_mid_stream(wt, op):
         "selector never escalated despite crossing the threshold"
     want = run_core(WinSeqCore(spec, red).use_incremental(), chunks)
     assert_equivalent(got, want)
+
+
+@pytest.mark.parametrize("role,map_indexes", [
+    (Role.SEQ, (0, 1)), (Role.MAP, (1, 3)), (Role.PLQ, (0, 1)),
+])
+@pytest.mark.parametrize("case", [1, 3])   # ooo+markers / gaps+ooo
+def test_lazy_sliding_escalation_roles_disorder(role, map_indexes, case):
+    """Escalation under the hard paths: role renumbering state
+    (emit_counter for MAP/PLQ), out-of-order drops, id gaps, and
+    mid-stream markers must all survive the per-key -> lane migration."""
+    from windflow_tpu.core.vecinc import LazySlidingCore, VecIncSlidingCore
+    rng = np.random.default_rng(71 + case)
+    spec = WindowSpec(10, 4, WinType.CB)
+    cfg = PatternConfig(id_outer=1, n_outer=2, slide_outer=8,
+                        id_inner=1, n_inner=3, slide_inner=4)
+    # clustered prefix (1 key) keeps the selector on the per-key core,
+    # then the full stream crosses the tiny threshold -> escalate
+    pre = batch_from_columns(SCHEMA, key=np.zeros(12),
+                             id=np.arange(12), ts=np.arange(12) * 3,
+                             value=rng.integers(-5, 50, 12))
+    chunks = [pre] + make_stream(rng, 25, 4, 150, **CASES[case])
+
+    def mk():
+        return Reducer("max")
+
+    lazy = LazySlidingCore(spec, mk(), threshold=8, config=cfg, role=role,
+                           map_indexes=map_indexes)
+    got = run_core(lazy, chunks)
+    assert isinstance(lazy._core, VecIncSlidingCore)
+    ref = WinSeqCore(spec, mk(), config=cfg, role=role,
+                     map_indexes=map_indexes).use_incremental()
+    assert_equivalent(got, run_core(ref, chunks))
+
+
+def test_lazy_sliding_escalation_multireducer():
+    """MultiReducer accumulators (count + max + sum lanes) migrate too."""
+    from windflow_tpu.core.vecinc import LazySlidingCore, VecIncSlidingCore
+    rng = np.random.default_rng(83)
+    spec = WindowSpec(12, 5, WinType.TB)
+    mk = MultiReducer(("count", None, "cnt"), ("max", "value", "mx"),
+                      ("sum", "value", "sm"))
+    pre = batch_from_columns(SCHEMA, key=np.zeros(10),
+                             id=np.arange(10), ts=np.arange(10) * 3,
+                             value=rng.integers(-5, 50, 10))
+    chunks = [pre] + make_stream(rng, 21, 4, 130, gaps=True)
+    lazy = LazySlidingCore(spec, MultiReducer(
+        ("count", None, "cnt"), ("max", "value", "mx"),
+        ("sum", "value", "sm")), threshold=8)
+    got = run_core(lazy, chunks)
+    assert isinstance(lazy._core, VecIncSlidingCore)
+    assert_equivalent(got, run_core(WinSeqCore(spec, mk).use_incremental(),
+                                    chunks))
