@@ -59,20 +59,34 @@ def test_fuzz_host_core_selection(seed):
     if slide < win:
         # the drawn cardinalities sit below the lazy selector's default
         # threshold, so force BOTH sliding-core regimes through the same
-        # config: the lane core directly, and the selector with a tiny
-        # threshold (escalation mid-stream when keys accumulate)
+        # config: the lane core directly, and the selector driven through
+        # a REAL mid-stream escalation (a single-key prefix chunk keeps
+        # the first pick on the per-key core; the following chunks cross
+        # the tiny threshold and migrate)
         from windflow_tpu.core.vecinc import (LazySlidingCore,
-                                              VecIncSlidingCore)
-        if -(-win // slide) <= 64:
-            direct = run_core(
-                VecIncSlidingCore(spec, Reducer(op, out_field="r"),
-                                  config=cfg, role=role, map_indexes=mi),
-                chunks)
-            assert_equivalent(direct, oracle)
-            lazy = LazySlidingCore(spec, Reducer(op, out_field="r"),
-                                   threshold=4, config=cfg, role=role,
-                                   map_indexes=mi)
-            assert_equivalent(run_core(lazy, chunks), oracle)
+                                              VecIncSlidingCore,
+                                              vec_core_supported)
+        assert vec_core_supported(spec, red)   # drawn ranges: W <= 19
+        direct = run_core(
+            VecIncSlidingCore(spec, Reducer(op, out_field="r"),
+                              config=cfg, role=role, map_indexes=mi),
+            chunks)
+        assert_equivalent(direct, oracle)
+        from windflow_tpu.core.tuples import batch_from_columns
+        from test_vecinc import SCHEMA
+        pre = batch_from_columns(SCHEMA, key=np.zeros(6),
+                                 id=np.arange(6), ts=np.arange(6) * 3,
+                                 value=np.arange(6))
+        esc_chunks = [pre] + chunks
+        esc_oracle = run_core(WinSeqCore(spec, Reducer(op, out_field="r"),
+                                         config=cfg, role=role,
+                                         map_indexes=mi), esc_chunks)
+        lazy = LazySlidingCore(spec, Reducer(op, out_field="r"),
+                               threshold=2, config=cfg, role=role,
+                               map_indexes=mi)
+        assert_equivalent(run_core(lazy, esc_chunks), esc_oracle)
+        assert isinstance(lazy._core, VecIncSlidingCore), \
+            "escalation never happened: the branch is vacuous"
 
 
 @pytest.mark.parametrize("seed", range(0, 16, 3))

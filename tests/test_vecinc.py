@@ -391,16 +391,17 @@ def test_lazy_sliding_escalation_multireducer():
     from windflow_tpu.core.vecinc import LazySlidingCore, VecIncSlidingCore
     rng = np.random.default_rng(83)
     spec = WindowSpec(12, 5, WinType.TB)
-    mk = MultiReducer(("count", None, "cnt"), ("max", "value", "mx"),
-                      ("sum", "value", "sm"))
+
+    def mk():
+        return MultiReducer(("count", None, "cnt"), ("max", "value", "mx"),
+                            ("sum", "value", "sm"))
+
     pre = batch_from_columns(SCHEMA, key=np.zeros(10),
                              id=np.arange(10), ts=np.arange(10) * 3,
                              value=rng.integers(-5, 50, 10))
     chunks = [pre] + make_stream(rng, 21, 4, 130, gaps=True)
-    lazy = LazySlidingCore(spec, MultiReducer(
-        ("count", None, "cnt"), ("max", "value", "mx"),
-        ("sum", "value", "sm")), threshold=8)
+    lazy = LazySlidingCore(spec, mk(), threshold=8)
     got = run_core(lazy, chunks)
     assert isinstance(lazy._core, VecIncSlidingCore)
-    assert_equivalent(got, run_core(WinSeqCore(spec, mk).use_incremental(),
+    assert_equivalent(got, run_core(WinSeqCore(spec, mk()).use_incremental(),
                                     chunks))
