@@ -170,7 +170,8 @@ def main():
                 or time.perf_counter() - t_bench0 > 360):
             break
     tps = N_TUPLES / best_dt
-    med = sorted(r["tps"] for r in runs)[len(runs) // 2]
+    import statistics
+    med = round(statistics.median(r["tps"] for r in runs), 1)
     # host-core control (no wire): same stream, same window math on the
     # host core.  When the device number undercuts it, the reader can
     # attribute the gap to the wire service the per-run diagnostics

@@ -176,3 +176,80 @@ def test_fuzz_nested_farm_distribution(seed):
     want = total(WinSeq(Reducer("sum"), win, slide, wt))
     got = total(WinFarm(Reducer("sum"), win, slide, wt, pardegree=deg))
     assert got == want, (win, slide, wt, deg)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_pipe_random_degrees(seed):
+    """Full-pipeline fuzz with re-drawn parallelism degrees — the
+    reference's randomized pipe_test idiom (test_pipe_wf_cb.cpp:233-264
+    re-draws mt19937 degrees per -r run): Source -> chain(Filter) ->
+    chain(Map) -> {WinFarm | KeyFarm | PaneFarm | WinMapReduce} -> Sink,
+    totals against the sequential oracle on the same stream."""
+    from windflow_tpu.api import MultiPipe
+    from windflow_tpu.patterns.basic import Filter, Map, Sink, Source
+    from windflow_tpu.patterns.key_farm import KeyFarm
+    from windflow_tpu.patterns.pane_farm import PaneFarm
+    from windflow_tpu.patterns.win_farm import WinFarm
+    from windflow_tpu.patterns.win_mapreduce import WinMapReduce
+    from windflow_tpu.patterns.win_seq import WinSeq
+    from test_vecinc import SCHEMA
+
+    rng = np.random.default_rng(5000 + seed)
+    win = int(rng.integers(2, 14))
+    slide = int(rng.integers(1, win + 1))
+    wt = WinType.CB if seed % 2 else WinType.TB
+    deg = int(rng.integers(2, 5))
+    deg2 = int(rng.integers(1, 4))
+    stage_deg = int(rng.integers(1, 4))
+    chunks = make_stream(rng, 11, 4, 170, markers_at_end=False)
+
+    kind = seed % 4
+
+    def window_stage():
+        if kind == 0:
+            return WinFarm(Reducer("sum"), win, slide, wt, pardegree=deg)
+        if kind == 1:
+            return KeyFarm(Reducer("sum"), win, slide, wt, pardegree=deg)
+        if kind == 2 and slide < win:
+            return PaneFarm(Reducer("sum"), Reducer("sum"), win, slide, wt,
+                            plq_degree=deg, wlq_degree=deg2)
+        return WinMapReduce(Reducer("sum"), Reducer("sum"), win, slide, wt,
+                            map_degree=max(deg, 2), reduce_degree=deg2)
+
+    def run_pipe(stage):
+        acc = [0]
+
+        def snk(rows):
+            if rows is not None and len(rows):
+                acc[0] += int(rows["value"].sum())
+
+        (MultiPipe(f"fuzz{seed}")
+         .add_source(Source(batches=iter(chunks), schema=SCHEMA))
+         .chain(Filter(lambda b: b["value"] % 7 != 0, vectorized=True,
+                       parallelism=stage_deg))
+         .chain(Map(lambda b: b.__setitem__("value", b["value"] * 2),
+                    vectorized=True, parallelism=stage_deg))
+         .add(stage)
+         .add_sink(Sink(snk, vectorized=True)))\
+            .run_and_wait_end()
+        return acc[0]
+
+    got = run_pipe(window_stage())
+    if kind == 1 and wt is WinType.CB:
+        # reference-faithful asymmetry (multipipe.hpp mode table): a
+        # Key_Farm is added with a plain KF_Emitter — its CB windows
+        # count RAW tuple ids, gaps and all (:547-589) — while window
+        # patterns exposing a spec get the broadcast/TS_RENUMBERING CB
+        # treatment (:494-537).  Oracle: the filtered/mapped stream fed
+        # straight to the sequential core, raw ids preserved.
+        core = WinSeqCore(WindowSpec(win, slide, wt), Reducer("sum"))
+        want = 0
+        for b in chunks:
+            keep = b["value"] % 7 != 0
+            fb = b[keep].copy()
+            fb["value"] = fb["value"] * 2
+            want += int(core.process(fb)["value"].sum())
+        want += int(core.flush()["value"].sum())
+    else:
+        want = run_pipe(WinSeq(Reducer("sum"), win, slide, wt))
+    assert got == want, (win, slide, wt, deg, deg2, stage_deg)

@@ -317,3 +317,49 @@ def test_two_process_row_channel_data_plane(tmp_path):
         want.setdefault(int(r["key"]), []).append(
             [int(r["id"]), int(r["value"])])
     assert merged == want
+
+
+def test_row_channel_fails_fast_on_dead_peer():
+    """A connection dying mid-stream must surface as an error from
+    batches(), never as a silently truncated stream (wrong totals)."""
+    import socket
+    import threading
+    import numpy as np
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.parallel.channel import RowReceiver, RowSender
+
+    schema = Schema(value=np.int64)
+    recv = RowReceiver(n_senders=1)
+
+    def half_send():
+        s = RowSender("127.0.0.1", recv.port)
+        ids = np.arange(4)
+        s.send(batch_from_columns(schema, key=np.zeros(4), id=ids, ts=ids,
+                                  value=ids))
+        # die without EOS: hard close mid-protocol
+        s._sock.shutdown(socket.SHUT_RDWR)
+        s._sock.close()
+
+    t = threading.Thread(target=half_send)
+    t.start()
+    got, err = [], None
+    try:
+        for b in recv.batches():
+            got.append(b)
+    except (ConnectionError, OSError) as e:
+        err = e
+    t.join()
+    assert err is not None, "dead peer was swallowed as EOS"
+
+
+def test_partition_and_ship_rejects_uncovered_owner():
+    import numpy as np
+    import pytest as _pytest
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.parallel.channel import partition_and_ship
+    schema = Schema(value=np.int64)
+    b = batch_from_columns(schema, key=np.arange(6), id=np.arange(6),
+                           ts=np.arange(6), value=np.arange(6))
+    owners = np.array([0, 1, 2, 0, 1, 2])
+    with _pytest.raises(KeyError, match="no\\s+RowSender"):
+        partition_and_ship(b, owners, 0, {1: object()})

@@ -156,7 +156,15 @@ class MultiPipe:
         get a TS_RENUMBERING front-end, so CB means "count of arriving
         tuples per key" exactly like the reference's broadcast+renumber CB
         path (:494-537); time-windows and keyed state get a TS merge when
-        the stream is unordered or multi-tailed."""
+        the stream is unordered or multi-tailed.
+
+        Deliberate reference-faithful asymmetry: a Key_Farm exposes no
+        window spec here and is added with its plain key-routing emitter
+        (:547-589 — no broadcast, no renumbering), so ITS count windows
+        run over RAW tuple ids, gaps and all.  Downstream of a Filter a
+        KeyFarm and a WinFarm therefore legitimately disagree on CB
+        window content — in the reference exactly as here
+        (tests/test_fuzz_differential.py pins both semantics)."""
         specs = [s for s in (_window_spec(p) for p in group) if s is not None]
         cb = any(s.win_type is WinType.CB for s in specs)
         sensitive = bool(specs) or any(_is_keyed(p) for p in group)
