@@ -197,7 +197,9 @@ def test_fuzz_pipe_random_degrees(seed):
     rng = np.random.default_rng(5000 + seed)
     win = int(rng.integers(2, 14))
     slide = int(rng.integers(1, win + 1))
-    wt = WinType.CB if seed % 2 else WinType.TB
+    # wt must NOT share parity with kind (seed % 4), or half the
+    # stage-by-wintype matrix is structurally unreachable
+    wt = WinType.CB if rng.random() < 0.5 else WinType.TB
     deg = int(rng.integers(2, 5))
     deg2 = int(rng.integers(1, 4))
     stage_deg = int(rng.integers(1, 4))

@@ -17,8 +17,8 @@ from .api import (LEVEL0, LEVEL1, LEVEL2, Accumulator_Builder,
                   WinSeq_Builder, WinSeqTPU_Builder, union_multipipes)
 from .core.tuples import Schema, batch_from_columns
 from .core.windows import WinType
-from .ops.functions import (FnWindowFunction, FnWindowUpdate, Reducer,
-                            WindowFunction, WindowUpdate)
+from .ops.functions import (FnWindowFunction, FnWindowUpdate, MultiReducer,
+                            Reducer, WindowFunction, WindowUpdate)
 from .patterns.basic import (Accumulator, Filter, FlatMap, Map, Shipper,
                              Sink, Source)
 from .patterns.key_farm import KeyFarm
@@ -39,7 +39,7 @@ __all__ = [
     "Schema", "batch_from_columns", "WinType", "RuntimeContext",
     # window-function contracts
     "WindowFunction", "WindowUpdate", "FnWindowFunction", "FnWindowUpdate",
-    "Reducer", "JaxWindowFunction",
+    "Reducer", "MultiReducer", "JaxWindowFunction",
     # patterns
     "Source", "Map", "Filter", "FlatMap", "Accumulator", "Sink", "Shipper",
     "WinSeq", "WinFarm", "KeyFarm", "PaneFarm", "WinMapReduce",

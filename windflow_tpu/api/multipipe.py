@@ -163,8 +163,10 @@ class MultiPipe:
         (:547-589 — no broadcast, no renumbering), so ITS count windows
         run over RAW tuple ids, gaps and all.  Downstream of a Filter a
         KeyFarm and a WinFarm therefore legitimately disagree on CB
-        window content — in the reference exactly as here
-        (tests/test_fuzz_differential.py pins both semantics)."""
+        window content — in the reference exactly as here (the KeyFarm
+        raw-id half is pinned by tests/test_fuzz_differential.py's pipe
+        fuzz; the WinFarm renumbered half by tests/test_multipipe.py's
+        Filter->WinFarm CB case)."""
         specs = [s for s in (_window_spec(p) for p in group) if s is not None]
         cb = any(s.win_type is WinType.CB for s in specs)
         sensitive = bool(specs) or any(_is_keyed(p) for p in group)
