@@ -68,7 +68,7 @@ def run_variant(variant, n_events=30000, pardegree2=4):
     return got, sink, sent
 
 
-@pytest.mark.parametrize("variant", ["kf", "kf-tpu", "wmr"])
+@pytest.mark.parametrize("variant", ["kf", "kf-tpu", "wmr", "wmr-tpu"])
 def test_ysb_counts_match_oracle(variant):
     n = 30000
     got, sink, sent = run_variant(variant)
@@ -168,3 +168,11 @@ def test_ysb_revenue_matches_oracle():
         for k, _c, _lu, r in got.rows:
             per_cmp[k] = per_cmp.get(k, 0) + r
         assert per_cmp == want_cmp, variant
+
+
+def test_ysb_wmr_tpu_differential():
+    """The device-MAP Win_MapReduce variant must produce the same windows
+    (count, lastUpdate, revenue) as the host kf variant."""
+    a, _, _ = run_variant("kf")
+    b, _, _ = run_variant("wmr-tpu")
+    assert sorted(a.rows) == sorted(b.rows)

@@ -244,6 +244,28 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
         agg = WinMapReduce(YSBAggregate(), YSBReduce(), win_us, win_us,
                            WinType.TB, map_degree=max(pardegree2, 2),
                            name="ysb_wmr", opt_level=opt_level)
+    elif variant == "wmr-tpu":
+        # Win_MapReduce with the MAP stage device-batched (the reference's
+        # Win_MapReduce_GPU per-stage placement, win_mapreduce_gpu.hpp):
+        # each MAP partition computes COUNT + MAX(ts) + SUM(revenue) on the
+        # resident ring (only revenue ships — pos-max split), REDUCE
+        # combines the partials host-side as a multi-field MultiReducer
+        from ..ops.functions import MultiReducer, Reducer
+        from ..patterns.win_seq_tpu import WinMapReduceTPU
+        # NOTE: no value_range on the reduce-stage max — its inputs are
+        # MAP partials whose empty-partition identity is iinfo(int64).min,
+        # far outside the raw-timestamp range (a declared range would
+        # falsely suppress the int32-wrap warning if this stage were ever
+        # flipped to reduce_on_device=True)
+        reduce_agg = MultiReducer(
+            Reducer("sum", "count", "count"),
+            Reducer("max", "lastUpdate", "lastUpdate"),
+            Reducer("sum", "revenue", "revenue", dtype=np.int32))
+        agg = WinMapReduceTPU(device_aggregate(), reduce_agg, win_us,
+                              win_us, WinType.TB,
+                              map_degree=max(pardegree2, 2),
+                              name="ysb_wmr_tpu", map_on_device=True,
+                              reduce_on_device=False, opt_level=opt_level)
     else:
         raise ValueError(f"unknown variant {variant!r}")
 
@@ -330,7 +352,8 @@ def main(argv=None):
                     help="generation time seconds (reference -l)")
     ap.add_argument("-p", "--pardegree1", type=int, default=1)
     ap.add_argument("-w", "--pardegree2", type=int, default=4)
-    ap.add_argument("--variant", choices=["kf", "kf-tpu", "wmr"],
+    ap.add_argument("--variant",
+                    choices=["kf", "kf-tpu", "wmr", "wmr-tpu"],
                     default="kf")
     ap.add_argument("--win-sec", type=float, default=10.0)
     ap.add_argument("--chunk", type=int, default=262144)
