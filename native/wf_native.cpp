@@ -996,7 +996,7 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     // per-key row continuity (B's rows must land right after A's in the
     // ring for B's descriptors to stay valid — true by construction for
     // adjacent flushes, verified here), regularity continuity, width
-    i64 newR = 1, maxoff = 0;
+    i64 newR = 1, maxoff = 0, cmaxA = 0, cmaxB = 0, cmaxM = 0;
     for (i64 k = 0; k < K2; ++k) {
         const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
         const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
@@ -1011,6 +1011,9 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
                     || B.rstart0[(size_t)k]
                            != A.rstart0[(size_t)k] + (int32_t)(ca * slide)))
                 return false;
+            cmaxA = std::max(cmaxA, ca);
+            cmaxB = std::max(cmaxB, cb);
+            cmaxM = std::max(cmaxM, ca + cb);
         }
         newR = std::max(newR, ra + rb);
         maxoff = std::max(maxoff,
@@ -1020,7 +1023,28 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     // the Python-side overflow guard is offs.max() + bucket(R) <= cap;
     // respect the same conservative bound so a merged launch never trips it
     if (maxoff + bucket(newR) > A.cap) return false;
-    if (!regular) {
+    if (regular) {
+        // regular dispatch shapes are keyed on (bucket(R), bucket(cmax)).
+        // Small per-key window counts can grow the row bucket while the
+        // window-count bucket stays clamped — bucket(ca+cb)==bucket(ca)
+        // — so merged shapes live on the LOWER TRIANGLE {(Rb*a, C*b),
+        // b <= a} of the pair's base shape, which is exactly the set
+        // prewarm_regular_ladder compiles (ADVICE r3: the diagonal alone
+        // left (2*Rb, C) cold).  Guard the triangle invariant: equal
+        // buckets in (both axes), and the window-count bucket may grow at
+        // most as fast as the row bucket — a pair whose C bucket would
+        // outgrow its R bucket (possible when one launch packs many more
+        // windows per row) dispatches a shape no warmup compiled: reject,
+        // the pair simply stays unmerged.
+        if (bucket(A.R) != bucket(B.R)
+            || bucket(std::max<i64>(cmaxA, 1))
+                   != bucket(std::max<i64>(cmaxB, 1)))
+            return false;
+        const i64 rr = bucket(newR) / bucket(A.R);
+        const i64 rc = bucket(std::max<i64>(cmaxM, 1))
+                       / bucket(std::max<i64>(cmaxA, 1));
+        if (rc > rr) return false;
+    } else {
         // irregular dispatch shapes are keyed on (bucket(R), bucket(B)):
         // keep merged shapes on the DIAGONAL ladder of the pair's base
         // shape — equal buckets in, proportional buckets out — so the

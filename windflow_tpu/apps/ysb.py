@@ -133,15 +133,19 @@ def device_aggregate():
     stat evaluates in one fused dispatch per flush (ops/resident.py:
     MultiFieldResidentExecutor).  Event timestamps are relative
     microseconds (event_batches), so the declared value_range proves the
-    int32 accumulate exact for runs under ~35 minutes; per-event revenue
-    is < 100, summed in int32 result dtype."""
+    int32 accumulate exact for runs under ~35 minutes.  Revenue keeps the
+    host variants' int64 result dtype (one shared result schema across
+    kf/kf-tpu/wmr/wmr-tpu) over the default int32 device accumulate; a TB
+    window's row count is unbounded, so the accumulate-wrap warning stays
+    armed for this stat by design (ADVICE r3) — the declared per-event
+    range documents the input but cannot prove a TB sum fits."""
     from ..ops.functions import MultiReducer, Reducer
 
     return MultiReducer(
         Reducer("count", out_field="count"),
         Reducer("max", "ts", "lastUpdate",
                 value_range=(0, 2_100_000_000)),
-        Reducer("sum", "revenue", "revenue", dtype=np.int32))
+        Reducer("sum", "revenue", "revenue", value_range=(0, 98)))
 
 
 def event_batches(duration_sec: float, chunk: int, campaigns,
@@ -260,7 +264,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
         reduce_agg = MultiReducer(
             Reducer("sum", "count", "count"),
             Reducer("max", "lastUpdate", "lastUpdate"),
-            Reducer("sum", "revenue", "revenue", dtype=np.int32))
+            Reducer("sum", "revenue", "revenue"))
         agg = WinMapReduceTPU(device_aggregate(), reduce_agg, win_us,
                               win_us, WinType.TB,
                               map_degree=max(pardegree2, 2),

@@ -775,11 +775,14 @@ class MeshResidentExecutor(ResidentWindowExecutor):
 
 def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
                            max_cells=1 << 24) -> int:
-    """Compile the coalesced-shape siblings of every regular step (plain
-    AND mesh-sharded) already compiled in this process.
+    """Compile the coalesced-shape siblings of every step (regular,
+    irregular, mesh) already compiled in this process.
 
-    Deep launch coalescing dispatches merged shapes (Rb*m, C*m) on the
-    {2x, 4x, ...} buddy ladder only under wire stall — exactly when a cold
+    Deep launch coalescing dispatches merged shapes on the {2x, 4x, ...}
+    buddy ladder — diagonal (Rb*m, B*m) siblings for irregular steps, the
+    lower triangle {(Rb*m, C*b), b <= m} for regular steps (try_merge
+    admits window-bucket growth at most proportional to row-bucket
+    growth) — only under wire stall, exactly when a cold
     ~10 s mid-run compile hurts most (BASELINE.md: odd-shape recompiles
     measured mid-benchmark).  A benchmark calls this once after its warmup
     run: whatever regular buckets the warmup compiled, their ladder
@@ -812,6 +815,15 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
             # executor is Python-core only, which never coalesces)
             _ops, cap, Rb, Bb, KP, blk_dt, acc_dt, pad = key
             mesh = axis = None
+        elif tag == "mesh":
+            # mesh irregular step: the coalescer merges irregular launches
+            # on the mesh-backed native path too (non-sum ops, TB windows),
+            # so merged (Rb*m, Bs*m) diagonal siblings must be warm as well
+            # (ADVICE r3).  The per-shard window bucket Bs tracks the total
+            # window count's bucket in the common case (strided shard
+            # assignment); the diagonal ladder covers exactly those.
+            (_t, ops_m, cap, Rb, Bb, KP, blk_dt, acc_dt, pad, mesh,
+             axis) = key
         else:
             continue
         for m in mults:
@@ -828,55 +840,92 @@ def prewarm_regular_ladder(mults=(2, 4, 8, 16), devices=None,
             if (KP // 2 + 1) * Rb * m > max_cells:
                 continue
             if isinstance(tag, tuple):
-                sk = (tag, cap, Rb * m, Bb * m, KP, blk_dt, acc_dt, pad)
-            elif mesh is None:
-                sk = ("reg", op, cap, Rb * m, KP, C * m, blk_dt, acc_dt,
-                      slide)
+                sks = [(tag, cap, Rb * m, Bb * m, KP, blk_dt, acc_dt, pad)]
+            elif tag == "mesh":
+                # the mesh dispatch key's window bucket Bs is PER-SHARD
+                # (bucket of the fullest shard's window count,
+                # MeshResidentExecutor.launch) while try_merge guards the
+                # TOTAL window bucket — clamping decouples them (merged
+                # per-shard counts can sit under the lo=8 clamp while rows
+                # double), so merged mesh shapes live on the same lower
+                # triangle as regular ones: warm {(Rb*m, Bs*b), b <= m}
+                sks = []
+                b = 1
+                while b <= m:
+                    sks.append(("mesh", ops_m, cap, Rb * m, Bb * b, KP,
+                                blk_dt, acc_dt, pad, mesh, axis))
+                    b *= 2
             else:
-                sk = ("mesh-reg", op, cap, Rb * m, KP, C * m, blk_dt,
-                      acc_dt, slide, mesh, axis)
-            if sk in _STEP_CACHE:
+                # regular merges live on the LOWER TRIANGLE {(Rb*a, C*b),
+                # b <= a}: small per-key window counts can clamp the C
+                # bucket while rows double (try_merge admits rc <= rr), so
+                # the diagonal sibling alone would leave e.g. (2*Rb, C)
+                # cold exactly when the coalescer builds it mid-stall
+                # (ADVICE r3)
+                sks = []
+                b = 1
+                while b <= m:
+                    if mesh is None:
+                        sks.append(("reg", op, cap, Rb * m, KP, C * b,
+                                    blk_dt, acc_dt, slide))
+                    else:
+                        sks.append(("mesh-reg", op, cap, Rb * m, KP, C * b,
+                                    blk_dt, acc_dt, slide, mesh, axis))
+                    b *= 2
+            todo = [sk for sk in sks if sk not in _STEP_CACHE]
+            if not todo:
                 continue
-            # cache only AFTER the warm dispatch succeeds: a transient
-            # wire error mid-warm must leave the key retryable, not
-            # "warm" with a cold executable behind it
-            if isinstance(tag, tuple):
-                fn = _make_step(sk)
-                for dev in devices:
-                    ring = jax.device_put(
-                        jnp.zeros((KP, cap), dtype=np.dtype(acc_dt)), dev)
-                    blk = jax.device_put(
-                        jnp.zeros((KP, Rb * m), dtype=np.dtype(blk_dt)),
-                        dev)
-                    zk = jax.device_put(jnp.zeros(KP, dtype=np.int32), dev)
-                    zb = jax.device_put(jnp.zeros(Bb * m, dtype=np.int32),
-                                        dev)
-                    _ring2, out = fn(ring, blk, zk, zb, zb, zb)
-                    jax.block_until_ready(out)
-            elif mesh is None:
-                fn = _make_regular_step(sk)
-                for dev in devices:
-                    ring = jax.device_put(
-                        jnp.zeros((KP, cap), dtype=np.dtype(acc_dt)), dev)
-                    blk = jax.device_put(
-                        jnp.zeros((KP, Rb * m), dtype=np.dtype(blk_dt)),
-                        dev)
-                    zi = jax.device_put(jnp.zeros(KP, dtype=np.int32), dev)
-                    _ring2, out = fn(ring, blk, zi, zi, zi, zi)
-                    jax.block_until_ready(out)
-            else:
+            # the warm inputs depend only on (family, m), never on the
+            # triangle's C value (it shapes the OUTPUT only) — allocate
+            # them once per placement and reuse across siblings (a ring is
+            # up to 128 MB; re-shipping it per sibling would stretch the
+            # warmup window for nothing)
+            if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                fn = _make_mesh_regular_step(sk)
                 s2 = NamedSharding(mesh, P(axis, None))
                 s1 = NamedSharding(mesh, P(axis))
+                placements = [(s2, s1)]
+            else:
+                placements = [(dev, dev) for dev in devices]
+            bases = []
+            for p2, p1 in placements:
                 ring = jax.device_put(
-                    jnp.zeros((KP, cap), dtype=np.dtype(acc_dt)), s2)
+                    jnp.zeros((KP, cap), dtype=np.dtype(acc_dt)), p2)
                 blk = jax.device_put(
-                    jnp.zeros((KP, Rb * m), dtype=np.dtype(blk_dt)), s2)
-                zi = jax.device_put(jnp.zeros(KP, dtype=np.int32), s1)
-                _ring2, out = fn(ring, blk, zi, zi, zi, zi)
-                jax.block_until_ready(out)
-            _STEP_CACHE[sk] = fn
-            _PREWARMED.add(sk)
-            warmed += 1
+                    jnp.zeros((KP, Rb * m), dtype=np.dtype(blk_dt)), p2)
+                zk = jax.device_put(jnp.zeros(KP, dtype=np.int32), p1)
+                bases.append((p2, p1, ring, blk, zk))
+            for sk in todo:
+                # cache only AFTER the warm dispatch succeeds: a transient
+                # wire error mid-warm must leave the key retryable, not
+                # "warm" with a cold executable behind it
+                if tag == "mesh":
+                    fn = _make_mesh_step(sk)
+                elif isinstance(tag, tuple):
+                    fn = _make_step(sk)
+                elif mesh is None:
+                    fn = _make_regular_step(sk)
+                else:
+                    fn = _make_mesh_regular_step(sk)
+                for p2, p1, ring, blk, zk in bases:
+                    # the window-descriptor vectors are the one input whose
+                    # shape varies across mesh/plain irregular siblings
+                    # (sk[4] / sk[3] is that sibling's Bs); regular steps
+                    # take per-key scalars only
+                    if tag == "mesh":
+                        S = int(mesh.shape[axis])
+                        zb = jax.device_put(
+                            jnp.zeros((S, sk[4]), dtype=np.int32), p2)
+                        args = (ring, blk, zk, zb, zb, zb)
+                    elif isinstance(tag, tuple):
+                        zb = jax.device_put(
+                            jnp.zeros(sk[3], dtype=np.int32), p1)
+                        args = (ring, blk, zk, zb, zb, zb)
+                    else:
+                        args = (ring, blk, zk, zk, zk, zk)
+                    _ring2, out = fn(*args)
+                    jax.block_until_ready(out)
+                _STEP_CACHE[sk] = fn
+                _PREWARMED.add(sk)
+                warmed += 1
     return warmed

@@ -13,8 +13,11 @@ into a pipeline exactly like a local one.
 Design notes (DCN-analog, deliberately boring):
 
 * batches cross as raw structured-array bytes with an 8-byte length
-  frame; the dtype travels once per connection (pickled — the channel
-  trusts its cluster, exactly like NCCL/MPI transports do);
+  frame; the dtype travels once per connection as JSON of
+  ``np.dtype(...).descr`` — a pure data encoding, so a hostile peer can
+  at worst describe a weird dtype, never execute code (the channel
+  trusts its cluster for data *integrity*, like NCCL/MPI transports do,
+  but the wire format must not turn that trust into code execution);
 * one receiver accepts any number of senders; per-connection reader
   threads feed one bounded queue, preserving per-sender batch order
   (cross-sender order is interleaved, as with any multi-producer edge —
@@ -25,7 +28,7 @@ Design notes (DCN-analog, deliberately boring):
 
 from __future__ import annotations
 
-import pickle
+import json
 import queue
 import socket
 import struct
@@ -34,6 +37,40 @@ import threading
 import numpy as np
 
 _LEN = struct.Struct("<q")
+
+
+def _encode_dtype(dtype) -> bytes:
+    """JSON-encode a dtype via numpy's ``.npy``-format codec
+    (``np.lib.format.dtype_to_descr``) — the one descr form numpy
+    guarantees round-trippable, covering nested structs, align padding,
+    sub-arrays, and unstructured dtypes (plain format strings).  ``None``
+    (the EOS-before-data placeholder) encodes as JSON ``null``."""
+    if dtype is None:
+        return b"null"
+    return json.dumps(np.lib.format.dtype_to_descr(np.dtype(dtype))
+                      ).encode("utf-8")
+
+
+def _tuplify_descr(d):
+    """JSON turns descr tuples into lists; ``descr_to_dtype`` wants the
+    original shapes back, recursively: a descr is a list of field-entry
+    *tuples* (possibly nested as a field's format), while sub-array
+    shapes and (title, name) pairs are tuples of scalars."""
+    if not isinstance(d, list):
+        return d
+    if d and all(isinstance(e, list) for e in d):
+        # a (possibly nested) struct descr: keep the list, tuplify entries
+        return [tuple(_tuplify_descr(x) for x in e) for e in d]
+    # a sub-array shape or a (title, name) pair
+    return tuple(_tuplify_descr(x) for x in d)
+
+
+def _decode_dtype(raw: bytes):
+    """Inverse of :func:`_encode_dtype`."""
+    descr = json.loads(raw.decode("utf-8"))
+    if descr is None:
+        return None
+    return np.lib.format.descr_to_dtype(_tuplify_descr(descr))
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -58,7 +95,7 @@ class RowSender:
         if len(batch) == 0:
             return
         if self._dtype_sent is None:
-            d = pickle.dumps(batch.dtype)
+            d = _encode_dtype(batch.dtype)
             self._sock.sendall(_LEN.pack(len(d)) + d)
             self._dtype_sent = batch.dtype
         elif batch.dtype != self._dtype_sent:
@@ -74,7 +111,7 @@ class RowSender:
             if self._dtype_sent is None:
                 # dtype never sent: ship a placeholder so the receiver's
                 # framing stays uniform (empty dtype, then EOS)
-                d = pickle.dumps(None)
+                d = _encode_dtype(None)
                 self._sock.sendall(_LEN.pack(len(d)) + d)
             self._sock.sendall(_LEN.pack(-1))
         finally:
@@ -115,14 +152,18 @@ class RowReceiver:
     def _read_loop(self, conn: socket.socket):
         try:
             n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
-            dtype = pickle.loads(_read_exact(conn, n))
+            dtype = _decode_dtype(_read_exact(conn, n))
             while True:
                 n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
                 if n < 0:
                     break
                 raw = _read_exact(conn, n)
                 self._q.put(np.frombuffer(raw, dtype=dtype).copy())
-        except (ConnectionError, OSError) as e:
+        except Exception as e:  # noqa: BLE001 — ANY reader failure (IO,
+            # undecodable dtype from a version-mismatched peer, bad frame)
+            # must surface in batches(); the finally's None alone would
+            # count this sender as a clean EOS and silently truncate the
+            # stream — the exact failure the docstring promises to prevent
             self._q.put(e)
         finally:
             conn.close()
