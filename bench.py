@@ -91,9 +91,14 @@ def run_once(batches, schema, host_core=False):
         # costs a scan pass + smaller launches (sweep 2026-07-30:
         # 1/2/4 shards -> 20.6/15.0/12.8M best-of tps); multi-core hosts
         # should raise shards to ~cores
+        # depth=48 + dispatch window 8 (native_core default): the
+        # 2026-07-31 interleaved sweeps (scripts/sweep_window.py) measured
+        # median 22.8M vs 20.7M at the r3 depth=24/window=4 in the same
+        # weather — deeper in-flight pipelining hides more of the
+        # per-dispatch RTT without upsizing any dispatch
         stage = WinSeqTPU(Reducer("sum", value_range=(0, 100)), WIN, SLIDE,
                           WinType.CB, batch_len=BATCH_LEN,
-                          flush_rows=FLUSH_ROWS, depth=24, shards=1)
+                          flush_rows=FLUSH_ROWS, depth=48, shards=1)
     df = Dataflow()
     build_pipeline(df, [
         Source(batches=batches, schema=schema),
@@ -146,12 +151,19 @@ def main():
     # best-of timed runs: the tunneled devices show large run-to-run
     # variance (BASELINE.md wire characterization: ±2x swings), and peak
     # throughput is the capability being measured.  At least 5 runs;
-    # when every run so far is wire-trashed (best below the baseline
-    # bar), keep sampling — up to 12 runs or a 6-minute wall budget —
-    # for a clean-wire window.  Good weather stops at 5 runs.
+    # sampling extends — up to 12 runs or a 6-minute wall budget — only
+    # on measured WIRE WEATHER (median per-run launch service above 2x
+    # the good-weather band), never on the score: extending while
+    # best < bar is optional stopping that inflates P(best >= bar) in
+    # exactly the marginal sessions (VERDICT r3 weak #1).  The fixed
+    # best-of-5 is always reported alongside so rounds stay comparable.
+    GOOD_LAUNCH_MS = 130.0   # upper edge of the band the 23.8M record
+    #                          was captured in (BASELINE.md: 49-129 ms);
+    #                          exogenous to the score by construction
     want = expected_total(batches)
     best_dt, n_windows = None, 0
     runs = []
+    import statistics
     t_bench0 = time.perf_counter()
     while True:
         dt, n_windows, total, diag = run_once(batches, schema)
@@ -164,13 +176,15 @@ def main():
             return 1
         runs.append({"tps": round(N_TUPLES / dt, 1), **diag})
         best_dt = dt if best_dt is None else min(best_dt, dt)
-        if len(runs) >= 5 and (
-                N_TUPLES / best_dt >= BASELINE_TUPLES_PER_SEC
-                or len(runs) >= 12
-                or time.perf_counter() - t_bench0 > 360):
-            break
+        if len(runs) >= 5:
+            stalled = statistics.median(
+                r.get("mean_launch_ms") or 0.0 for r in runs
+            ) > 2 * GOOD_LAUNCH_MS
+            if (not stalled or len(runs) >= 12
+                    or time.perf_counter() - t_bench0 > 360):
+                break
     tps = N_TUPLES / best_dt
-    import statistics
+    best5 = max(r["tps"] for r in runs[:5])
     med = round(statistics.median(r["tps"] for r in runs), 1)
     # host-core control (no wire): same stream, same window math on the
     # host core.  When the device number undercuts it, the reader can
@@ -204,15 +218,19 @@ def main():
         # stalled (tunnel weather), not framework-bound: judge the value
         # against median_tps and the per-run spread
         "median_tps": med,
+        # the fixed symmetric draw, reported ALWAYS: best of the first 5
+        # runs regardless of any extension, so rounds with and without
+        # weather-extended sampling compare like for like
+        "best5_tps": round(best5, 1),
+        "vs_baseline_best5": round(best5 / BASELINE_TUPLES_PER_SEC, 3),
         "host_core_tps": round(host_tps, 1),
         **({"host_core_error": host_err} if host_err else {}),
-        # the sampling rule is part of the artifact: best-of is NOT a
-        # fixed-N draw (sub-bar sessions get up to 12 attempts at a
-        # clean-wire window), so cross-session comparisons must read
-        # n_runs, not assume symmetric sampling
+        # the sampling rule is part of the artifact: extension triggers on
+        # measured wire weather (exogenous), never on the score
         "n_runs": len(runs),
         "sampling": "best-of: >=5 runs, extends to <=12 (6 min wall) "
-                    "while best < baseline bar",
+                    "while median mean_launch_ms > 260 (2x good-weather "
+                    "band); best5_tps is the fixed best-of-5",
         "runs": runs,
     }))
     return 0

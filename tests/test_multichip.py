@@ -215,3 +215,142 @@ def test_mesh_regular_descriptors_engage_and_match():
         MeshResidentExecutor.launch_regular = orig
     assert got == ref
     assert calls, "regular-descriptor mesh dispatch never engaged"
+
+
+def test_mesh_multifield_matches_host():
+    """Multi-FIELD MultiReducer (stats over two different payload fields)
+    on per-field mesh-sharded rings: the general whole-tuple functor
+    contract (win_seq_gpu.hpp:54-67) distributed over the kf axis
+    (MeshMultiFieldResidentExecutor, VERDICT r3 item 7)."""
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.core.windows import WindowSpec
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.ops.resident import MeshMultiFieldResidentExecutor
+    from windflow_tpu.patterns.win_seq_tpu import make_core_for
+
+    schema = Schema(a=np.int64, b=np.int64)
+    rng = np.random.default_rng(17)
+    batches = []
+    for lo in range(0, 96, 23):
+        m = min(23, 96 - lo)
+        ids = np.repeat(np.arange(lo, lo + m), 11)
+        ks = np.tile(np.arange(11), m)
+        batches.append(batch_from_columns(
+            schema, key=ks, id=ids, ts=ids,
+            a=rng.integers(0, 100, m * 11), b=rng.integers(0, 60, m * 11)))
+
+    mf = MultiReducer(("count", None, "cnt"), ("sum", "a", "sa"),
+                      ("max", "b", "mb"), ("min", "a", "na"))
+    spec = WindowSpec(WIN, SLIDE, WinType.CB)
+    mesh = make_mesh(n_kf=4)
+
+    def run_core(core):
+        outs = [core.process(b) for b in batches]
+        outs.append(core.flush())
+        outs = [o for o in outs if len(o)]
+        res = np.concatenate(outs)
+        return np.sort(res, order=["key", "id"])
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mf, mesh=mesh, batch_len=16)
+        assert isinstance(core.executor, MeshMultiFieldResidentExecutor)
+        got = run_core(core)
+    want = run_core(WinSeqCore(spec, mf))
+    assert len(got) == len(want)
+    for f in ("key", "id", "ts", "cnt", "sa", "mb", "na"):
+        np.testing.assert_array_equal(got[f], want[f], err_msg=f)
+
+
+def test_mesh_jax_fn_matches_host():
+    """An arbitrary batched JaxWindowFunction over two fields evaluates on
+    the mesh-sharded per-field rings — one SPMD dispatch per flush."""
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.core.windows import WindowSpec
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.functions import WindowFunction
+    from windflow_tpu.patterns.win_seq_tpu import (JaxWindowFunction,
+                                                   make_core_for)
+
+    schema = Schema(a=np.int64, b=np.int64)
+    batches = []
+    for lo in range(0, 72, 24):
+        ids = np.repeat(np.arange(lo, lo + 24), 5)
+        ks = np.tile(np.arange(5), 24)
+        batches.append(batch_from_columns(
+            schema, key=ks, id=ids, ts=ids, a=ids % 13, b=(ids * 5) % 7))
+
+    class HostDot(WindowFunction):
+        result_fields = {"dot": np.int64}
+        required_fields = ("a", "b")
+
+        def apply(self, key, gwid, rows):
+            return (int((rows["a"] * rows["b"]).sum()),)
+
+    import jax.numpy as jnp
+
+    def fn(keys, gwids, cols, mask):
+        return (jnp.sum(jnp.where(mask, cols["a"] * cols["b"], 0), axis=1),)
+
+    jf = JaxWindowFunction(fn, fields=("a", "b"),
+                           result_fields={"dot": np.int64})
+    spec = WindowSpec(WIN, SLIDE, WinType.CB)
+    mesh = make_mesh(n_kf=4)
+
+    def run_core(core):
+        outs = [core.process(b) for b in batches]
+        outs.append(core.flush())
+        outs = [o for o in outs if len(o)]
+        res = np.concatenate(outs)
+        return np.sort(res, order=["key", "id"])
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = run_core(make_core_for(spec, jf, mesh=mesh, batch_len=16))
+    want = run_core(WinSeqCore(spec, HostDot()))
+    assert len(got) == len(want)
+    for f in ("key", "id", "ts", "dot"):
+        np.testing.assert_array_equal(got[f], want[f], err_msg=f)
+
+
+def test_mesh_with_host_shards_matches_host():
+    """Host key-sharding composes with mesh execution (r3 weak #5): each
+    shard's C++ bookkeeping feeds its OWN P(kf, None)-sharded ring, so a
+    multicore host parallelises the hot loop while every dispatch still
+    serves all key groups."""
+    from windflow_tpu import native as native_mod
+    if native_mod.enabled() is None:
+        pytest.skip("native library unavailable")
+    from windflow_tpu.core.windows import WindowSpec
+    from windflow_tpu.core.winseq import WinSeqCore
+    from windflow_tpu.ops.resident import MeshResidentExecutor
+    from windflow_tpu.patterns.win_seq_tpu import make_core_for
+
+    spec = WindowSpec(WIN, SLIDE, WinType.CB)
+    mesh = make_mesh(n_kf=4)
+    batches = cb_stream_batches(13, 110)
+
+    def run_core(core):
+        outs = [core.process(b) for b in batches]
+        outs.append(core.flush())
+        outs = [o for o in outs if len(o)]
+        res = np.concatenate(outs)
+        return np.sort(res, order=["key", "id"])
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, Reducer("sum"), mesh=mesh, shards=2,
+                             batch_len=16)
+        assert len(core.executors) == 2
+        assert all(isinstance(ex, MeshResidentExecutor)
+                   for ex in core.executors)
+        got = run_core(core)
+    want = run_core(WinSeqCore(WindowSpec(WIN, SLIDE, WinType.CB),
+                               Reducer("sum")))
+    assert len(got) == len(want)
+    for f in ("key", "id", "ts", "value"):
+        np.testing.assert_array_equal(got[f], want[f], err_msg=f)

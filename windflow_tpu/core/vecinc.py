@@ -592,26 +592,98 @@ class VecIncSlidingCore(VecIncTumblingCore):
         return out
 
 
+#: derived crossover cache, keyed by window shape — measured on THIS host
+_SLIDING_THRESHOLD = {}
+
+
+def derived_sliding_threshold(spec: WindowSpec = None,
+                              force: bool = False) -> int:
+    """Measure the per-key-core vs lane-core crossover cardinality on
+    THIS host for this window SHAPE (r3 weak #4: the old hard-coded 512
+    encoded the 1-core bench host; a multicore or faster host — or a
+    denser window cadence, which multiplies the per-key core's
+    per-window Python overhead — shifts the economics in an unmeasured
+    direction).  Times both cores on a small synthetic stream of the
+    given (win, slide) at two cardinalities, fits each as linear in key
+    count, and solves for the intersection.  Cached per shape per
+    process (~0.3-0.6 s once); mispredictions cost only throughput —
+    LazySlidingCore migrates state if the stream later crosses whatever
+    threshold this returns."""
+    if spec is None:
+        spec = WindowSpec(8, 2, WinType.CB)
+    ck = (int(spec.win_len), int(spec.slide_len))
+    if ck in _SLIDING_THRESHOLD and not force:
+        return _SLIDING_THRESHOLD[ck]
+    import time as _t
+
+    from .tuples import Schema, batch_from_columns
+    from .winseq import WinSeqCore
+    cal_spec = WindowSpec(ck[0], ck[1], WinType.CB)
+    schema = Schema(value=np.int64)
+    red = Reducer("sum")
+    # enough rows that windows actually fire at the instance's cadence
+    # for every probed cardinality, capped so wide-slide shapes keep the
+    # one-off calibration under ~a second
+    lo_k, hi_k = 64, 2048
+    rows = max(4096, min(hi_k * 4 * ck[1], 1 << 17))
+
+    def once(cls, nk):
+        per = rows // nk
+        ids = np.tile(np.arange(per, dtype=np.int64), nk)
+        keys = np.repeat(np.arange(nk, dtype=np.int64), per)
+        order = np.argsort(ids, kind="stable")   # interleave keys
+        b = batch_from_columns(schema, key=keys[order], id=ids[order],
+                               ts=ids[order], value=ids[order] % 97)
+        best = None
+        for _ in range(2):        # best-of: least interference
+            core = cls(cal_spec, red)
+            t0 = _t.perf_counter()
+            core.process(b)
+            core.flush()
+            dt = _t.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    pk_lo, pk_hi = once(WinSeqCore, lo_k), once(WinSeqCore, hi_k)
+    vec_lo, vec_hi = (once(VecIncSlidingCore, lo_k),
+                      once(VecIncSlidingCore, hi_k))
+    # t(nk) = t_lo + b*(nk - lo_k) per core; the lines meet at
+    # nk* = lo_k + (vec_lo - pk_lo) / (pk_b - vec_b)
+    pk_b = (pk_hi - pk_lo) / (hi_k - lo_k)
+    vec_b = (vec_hi - vec_lo) / (hi_k - lo_k)
+    if pk_b <= vec_b:
+        # per-key never loses ground with cardinality here (e.g. a many-
+        # core host whose dict path scales): keep a high threshold so the
+        # migration path still covers extreme cardinalities
+        nk_star = hi_k
+    else:
+        nk_star = lo_k + (vec_lo - pk_lo) / (pk_b - vec_b)
+    th = int(min(max(nk_star, 64), 8192))
+    _SLIDING_THRESHOLD[ck] = th
+    return th
+
+
 class LazySlidingCore:
     """Defers the sliding-core choice to observed key cardinality: the
-    per-key-group ``WinSeqCore`` wins below ~512 distinct keys, the
-    lane-vectorised ``VecIncSlidingCore`` above (measured crossover
-    between 256 and 1024 keys on the 1-core bench host — 64 keys: 2.9M
-    vs 1.6M tps; 16k keys: 0.24M vs 4.0M).  The first chunk picks the
-    initial core; if a key-clustered stream later crosses the threshold
-    (e.g. per-key-partitioned replay whose first chunk carries few
-    keys), the per-key core's state MIGRATES into the lane core — its
-    NIC archives hold exactly the live rows the open-window lanes need —
-    so the choice is never locked in.  Mispredictions cost only
-    throughput, never correctness: both cores are differentially
-    identical."""
+    per-key-group ``WinSeqCore`` wins at low key counts, the
+    lane-vectorised ``VecIncSlidingCore`` above a crossover MEASURED on
+    the running host (derived_sliding_threshold — on the 1-core bench
+    host it lands between 256 and 1024 keys: 64 keys 2.9M vs 1.6M tps,
+    16k keys 0.24M vs 4.0M).  The first chunk picks the initial core; if
+    a key-clustered stream later crosses the threshold (e.g. per-key-
+    partitioned replay whose first chunk carries few keys), the per-key
+    core's state MIGRATES into the lane core — its NIC archives hold
+    exactly the live rows the open-window lanes need — so the choice is
+    never locked in.  Mispredictions cost only throughput, never
+    correctness: both cores are differentially identical."""
 
-    def __init__(self, spec: WindowSpec, winfunc, threshold: int = 512,
+    def __init__(self, spec: WindowSpec, winfunc, threshold: int = None,
                  **kw):
         self.spec = spec
         self.winfunc = winfunc
         self._kw = kw
-        self._threshold = threshold
+        self._threshold = (int(threshold) if threshold is not None
+                           else derived_sliding_threshold(spec))
         self._core = None
         self._perkey = False
         self.result_schema = Schema(**winfunc.result_fields)

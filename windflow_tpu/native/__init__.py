@@ -78,6 +78,8 @@ def _bind(lib):
     lib.wf_core_eos.argtypes = [ctypes.c_void_p]
     lib.wf_core_force_flush.restype = i64
     lib.wf_core_force_flush.argtypes = [ctypes.c_void_p]
+    lib.wf_core_set_flush_rows.restype = None
+    lib.wf_core_set_flush_rows.argtypes = [ctypes.c_void_p, i64]
     lib.wf_cores_process_mt.restype = i64
     lib.wf_cores_process_mt.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,

@@ -66,6 +66,14 @@ def stats_add(name: str, value=1):
         _STATS[name] = _STATS.get(name, 0) + value
 
 
+def stats_max(name: str, value):
+    """High-water gauge (e.g. the deepest proactive flush multiple a run
+    reached) — snapshot/reset like the counters."""
+    with _STATS_MU:
+        if value > _STATS.get(name, 0):
+            _STATS[name] = value
+
+
 def stats_snapshot(reset: bool = False) -> dict:
     """{"dispatches", "merges", "mean_launch_ms"} since the last reset."""
     with _STATS_MU:
@@ -79,6 +87,30 @@ def stats_snapshot(reset: bool = False) -> dict:
     return snap
 
 _REDUCE_OPS = ("sum", "min", "max", "prod")
+
+#: process-global wire-weather record: an EMA of RAW per-dispatch launch
+#: service in ms, deliberately NOT normalized by dispatch size — the
+#: sizing rule's thresholds (_pick_flush_mult) are calibrated for raw
+#: values, and the 2026-07-31 A/B showed service is not size-linear on
+#: this wire.  It outlives executors, so a timed run can size its first
+#: dispatches from the warmup run's measured weather instead of
+#: discovering the stall one small launch at a time — the proactive half
+#: of dispatch sizing (VERDICT r3 item 1; the reactive half is
+#: wf_launch_coalesce).
+_WEATHER = {"ema_ms": None}
+
+
+def note_wire_service_ms(ms: float, weight: float = 0.2):
+    """Fold one raw per-dispatch launch-service observation (ms) into the
+    global wire-weather EMA."""
+    prev = _WEATHER["ema_ms"]
+    _WEATHER["ema_ms"] = ms if prev is None else (
+        (1.0 - weight) * prev + weight * ms)
+
+
+def wire_weather_ms():
+    """Current wire-weather estimate (None before any observation)."""
+    return _WEATHER["ema_ms"]
 
 
 def _pad2(a, rows, cols):
@@ -264,6 +296,7 @@ class ResidentWindowExecutor:
         self._inflight = deque()   # (meta, sel, device_out, t_dispatch)
         self._ready = []
         self._svc = deque(maxlen=32)   # recent dispatch→ready seconds
+        self._svc_mean = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -394,6 +427,11 @@ class ResidentWindowExecutor:
     def _note_service(self, t0: float):
         dt = time.perf_counter() - t0
         self._svc.append(dt)
+        # fold the window mean here, on the harvesting thread: readers on
+        # OTHER threads (the proactive flush sizer runs on the node
+        # thread) then see one atomic float instead of iterating a deque
+        # that a ship thread is appending to
+        self._svc_mean = sum(self._svc) / len(self._svc)
         stats_add("svc_s_sum", dt)
         stats_add("svc_n", 1)
 
@@ -401,8 +439,9 @@ class ResidentWindowExecutor:
         """Mean dispatch→ready wall time of recent launches (slightly
         overestimates when results sit ready before the next harvest poll;
         the poll cadence is the chunk cadence, well under the ~20 ms
-        threshold the adaptive coalescer keys on)."""
-        return (sum(self._svc) / len(self._svc)) if self._svc else 0.0
+        threshold the adaptive coalescer keys on).  Safe to read from any
+        thread."""
+        return self._svc_mean
 
     def _harvest_one(self):
         meta, sel, out, t0 = self._inflight.popleft()
@@ -519,6 +558,7 @@ class MultiFieldResidentExecutor(ResidentWindowExecutor):
         self._inflight = deque()
         self._ready = []
         self._svc = deque(maxlen=32)
+        self._svc_mean = 0.0
         self._step_cache = {}   # per-executor cache for fn-bound steps
 
     # single-field plumbing from the base class that does not apply
@@ -620,6 +660,180 @@ class MultiFieldResidentExecutor(ResidentWindowExecutor):
         meta, B, out, t0 = self._inflight.popleft()
         with profile.span("harvest_wait"):
             arrs = tuple(np.asarray(o)[:B] for o in out)
+        self._note_service(t0)
+        self._ready.append((meta, arrs))
+
+
+def _make_mesh_multi_step(key, jax_fn):
+    """Sharded fused multi-field append+eval: shard_map over the key-group
+    axis of the per-field rings — each device appends its row block of
+    EVERY field's ring and evaluates its own windows' stats/fn (windows
+    are row-local, so the program has no collectives; the multi-chip form
+    of the whole-tuple functor contract, win_seq_gpu.hpp:54-67 x SURVEY
+    §2.8)."""
+    (_tag, fields, stats, _fnid, cap, Rb, Bs, KP, wires, accs, pad, mesh,
+     axis) = key
+    acc_dts = tuple(np.dtype(a) for a in accs)
+    fidx = {f: i for i, f in enumerate(fields)}
+    from jax.sharding import PartitionSpec as P
+
+    def local(rings, blks, offs, lrows, lstarts, llens, lkeys, lgwids):
+        # per-shard views: rings/blks (rps, .) per field, offs (rps,),
+        # descriptors (1, Bs) — this shard's windows, host pre-grouped
+        rings = tuple(_ring_append(r, b, offs, dt)
+                      for r, b, dt in zip(rings, blks, acc_dts))
+        wrows, wstarts, wlens = lrows[0], lstarts[0], llens[0]
+        outs = []
+        for op, f in stats:
+            outs.append(_ring_eval(op, cap, pad, acc_dts[fidx[f]],
+                                   rings[fidx[f]], wrows, wstarts, wlens))
+        if jax_fn is not None:
+            idx = jnp.minimum(
+                wstarts[:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :],
+                cap - 1)
+            mask = jnp.arange(pad, dtype=jnp.int32)[None, :] < wlens[:, None]
+            cols = {}
+            for f in jax_fn.fields:
+                vals = rings[fidx[f]][wrows[:, None], idx]
+                cols[f] = jnp.where(mask, vals, 0)
+            res = jax_fn.fn(lkeys[0], lgwids[0], cols, mask)
+            outs.extend(res if isinstance(res, tuple) else (res,))
+        outs = tuple(o[None, :] for o in outs)
+        return rings, outs
+
+    n_f = len(fields)
+    mapped = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=((P(axis, None),) * n_f, (P(axis, None),) * n_f,
+                  P(axis), P(axis, None), P(axis, None), P(axis, None),
+                  P(axis, None), P(axis, None)),
+        out_specs=((P(axis, None),) * n_f, P(axis, None)))
+    return jax.jit(mapped)
+
+
+class MeshMultiFieldResidentExecutor(MultiFieldResidentExecutor):
+    """Multi-field resident rings sharded ``P(kf, None)`` over a mesh:
+    the per-field-ring generalisation of :class:`MeshResidentExecutor` —
+    arbitrary multi-stat reducers and batched JAX window functions run
+    over key-group-sharded archives, one SPMD dispatch for every group
+    (VERDICT r3 item 7: the general whole-tuple functor contract,
+    win_seq_gpu.hpp:54-67, distributed over the ICI mesh)."""
+
+    def __init__(self, fields, stats=(), jax_fn=None, acc_dtypes=None,
+                 mesh=None, axis: str = "kf", depth: int = 8):
+        if mesh is None or axis not in mesh.shape:
+            raise ValueError(f"need a mesh with axis {axis!r}")
+        super().__init__(fields, stats=stats, jax_fn=jax_fn,
+                         acc_dtypes=acc_dtypes,
+                         device=mesh.devices.flat[0], depth=depth)
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = int(mesh.shape[axis])
+
+    def _sharding(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(*spec))
+
+    def reset(self, n_keys: int, cap: int):
+        S = self.n_shards
+        rows_per_shard = _bucket(max(-(-max(n_keys, 1) // S), 1))
+        self.KP = S * rows_per_shard
+        self.cap = _bucket(max(cap, 16))
+        self._rings = None
+
+    def _rings_arr(self):
+        if self._rings is None:
+            self._rings = tuple(
+                jax.device_put(
+                    jnp.zeros((self.KP, self.cap),
+                              dtype=self.acc_dtypes[f]),
+                    self._sharding(self.axis, None))
+                for f in self.fields)
+        return self._rings
+
+    def launch(self, meta, blks: dict, offs: np.ndarray,
+               wrows: np.ndarray, wstarts: np.ndarray, wlens: np.ndarray,
+               wkeys: np.ndarray = None, wgwids: np.ndarray = None):
+        S = self.n_shards
+        K, R = next(iter(blks.values())).shape
+        if K > self.KP:
+            raise ValueError("rectangle exceeds ring rows; reset() first")
+        rps = self.KP // S
+        B = len(wstarts)
+        wrows = np.asarray(wrows, dtype=np.int64)
+        # stride dense key rows over shards (MeshResidentExecutor.launch)
+        shard = wrows % S
+        local = wrows // S
+        slots = np.zeros(B, dtype=np.int64)
+        maxc = 0
+        for s in range(S):
+            m = shard == s
+            c = int(m.sum())
+            slots[m] = np.arange(c)
+            maxc = max(maxc, c)
+        Bs = _bucket(max(maxc, 1))
+        lrows = np.zeros((S, Bs), dtype=np.int32)
+        lstarts = np.zeros((S, Bs), dtype=np.int32)
+        llens = np.zeros((S, Bs), dtype=np.int32)
+        lkeys = np.zeros((S, Bs), dtype=np.int64)
+        lgwids = np.zeros((S, Bs), dtype=np.int64)
+        if B:
+            lrows[shard, slots] = local.astype(np.int32)
+            lstarts[shard, slots] = wstarts
+            llens[shard, slots] = wlens
+            # the caller sends empty header columns when no fn is bound
+            if wkeys is not None and len(wkeys) == B:
+                lkeys[shard, slots] = wkeys
+            if wgwids is not None and len(wgwids) == B:
+                lgwids[shard, slots] = wgwids
+        Rb = _bucket(max(R, 1))
+        _check_ring_overflow(offs, Rb, self.cap)
+        pad = (_bucket(int(wlens.max()) if B else 1)
+               if (self.jax_fn is not None
+                   or any(op != "sum" for op, _f in self.stats)) else 0)
+        wires = tuple(blks[f].dtype.str for f in self.fields)
+        key = ("mesh-multi", self.fields, self.stats, None, self.cap, Rb,
+               Bs, self.KP, wires,
+               tuple(self.acc_dtypes[f].str for f in self.fields), pad,
+               self.mesh, self.axis)
+        cache = _STEP_CACHE if self.jax_fn is None else self._step_cache
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _make_mesh_multi_step(key, self.jax_fn)
+        # shard-major physical scatter (MeshResidentExecutor.launch)
+        rows = np.arange(K)
+        prow = (rows % S) * rps + rows // S
+        offsp = np.zeros(self.KP, dtype=np.int32)
+        offsp[prow] = offs
+        blkps = []
+        for f in self.fields:
+            bp = np.zeros((self.KP, Rb), dtype=blks[f].dtype)
+            bp[prow, :R] = blks[f]
+            blkps.append(jax.device_put(bp, self._sharding(self.axis,
+                                                           None)))
+            profile.add("bytes_shipped", blks[f].nbytes)
+            profile.add("rows_shipped", blks[f].size)
+        profile.add("windows", B)
+        s2 = self._sharding(self.axis, None)
+        args = (tuple(blkps),
+                jax.device_put(offsp, self._sharding(self.axis)),
+                jax.device_put(lrows, s2), jax.device_put(lstarts, s2),
+                jax.device_put(llens, s2), jax.device_put(lkeys, s2),
+                jax.device_put(lgwids, s2))
+        with profile.span("dispatch"):
+            self._rings, out = fn(self._rings_arr(), *args)
+            for o in out:
+                getattr(o, "copy_to_host_async", lambda: None)()
+        stats_add("dispatches")
+        self._inflight.append((meta, (shard, slots), out,
+                               time.perf_counter()))
+        while len(self._inflight) > self.depth:
+            self._harvest_one()
+
+    def _harvest_one(self):
+        meta, sel, out, t0 = self._inflight.popleft()
+        with profile.span("harvest_wait"):
+            arrs = tuple(np.asarray(o)[sel[0], sel[1]] for o in out)
         self._note_service(t0)
         self._ready.append((meta, arrs))
 

