@@ -909,6 +909,49 @@ i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
 
 i64 wf_core_eos(void *h) { return ((Core *)h)->eos(); }
 
+// --------------------------------------------------------------- renumber
+// Per-key dense id renumbering for the ordering layer's single-channel
+// TS_RENUMBERING fast path: out[i] = counter[key[i]]++ in one pass, the
+// counter table living in the handle so it persists across batches (the
+// Python groupby-cumcount needs a stable argsort per batch — measured
+// 2026-07-31 at ~6.5M rows/s against this loop's memory-speed pass).
+// Small non-negative keys ride a dense vector; anything else the map.
+struct Renumber {
+    std::vector<i64> dense;
+    std::unordered_map<i64, i64> sparse;
+};
+
+void *wf_renum_new() { return new Renumber(); }
+
+void wf_renum_free(void *h) { delete (Renumber *)h; }
+
+void wf_renum_run(void *h, const i64 *keys, i64 n, i64 *out) {
+    Renumber *r = (Renumber *)h;
+    for (i64 i = 0; i < n; ++i) {
+        const i64 k = keys[i];
+        if (k >= 0 && k < (1 << 20)) {
+            if ((i64)r->dense.size() <= k)
+                r->dense.resize((size_t)(k + 1), 0);
+            out[i] = r->dense[(size_t)k]++;
+        } else {
+            out[i] = r->sparse[k]++;
+        }
+    }
+}
+
+// counter lookup + post-increment for one key (marker replay at flush:
+// the marker row takes the next id exactly like the general path's
+// per-key emit_counter)
+i64 wf_renum_next(void *h, i64 key) {
+    Renumber *r = (Renumber *)h;
+    if (key >= 0 && key < (1 << 20)) {
+        if ((i64)r->dense.size() <= key)
+            r->dense.resize((size_t)(key + 1), 0);
+        return r->dense[(size_t)key]++;
+    }
+    return r->sparse[key]++;
+}
+
 // proactive dispatch sizing: the host adjusts the natural launch size to
 // the measured wire service (a power-of-2 multiple of the configured
 // flush_rows, so natural shapes stay on the prewarmed bucket ladder) —

@@ -445,3 +445,53 @@ def test_builder_cuda_args_warn_and_ignore():
     with pytest.warns(UserWarning):
         WinSeqTPU_Builder(Reducer("sum")).withCBWindow(4, 2) \
             .withScratchpad(64).build()
+
+
+def test_renumbering_single_channel_fast_path_matches_general():
+    """The single-upstream TS_RENUMBERING fast path (arrival-order
+    vectorised/native cumcount, no pos argsort) must be row-identical to
+    the general merge path, markers included (r4: the general path was
+    the pipe benchmark's largest host cost)."""
+    import numpy as np
+
+    from windflow_tpu.core.tuples import (MARKER_FIELD, Schema,
+                                          batch_from_columns)
+    from windflow_tpu.runtime.ordering import OrderingCore, OrderingMode
+
+    rng = np.random.default_rng(23)
+    schema = Schema(value=np.int64)
+    batches = []
+    nxt = {}
+    for _ in range(6):
+        n = int(rng.integers(50, 300))
+        keys = rng.integers(0, 7, n)
+        ids = np.empty(n, dtype=np.int64)
+        for i, k in enumerate(keys):     # per-key ordered ids (contract)
+            ids[i] = nxt.get(int(k), 0)
+            nxt[int(k)] = ids[i] + 1
+        b = batch_from_columns(schema, key=keys, id=ids, ts=ids * 10,
+                               value=rng.integers(0, 99, n))
+        batches.append(b)
+    # a marker row per key at the end (EOS markers ride the same edge)
+    mk = batch_from_columns(schema, key=np.arange(7),
+                            id=[nxt.get(k, 0) for k in range(7)],
+                            ts=[nxt.get(k, 0) * 10 for k in range(7)],
+                            value=np.zeros(7))
+    mk[MARKER_FIELD] = True
+    batches.append(mk)
+
+    def run(nch):
+        core = OrderingCore(nch, OrderingMode.TS_RENUMBERING)
+        outs = []
+        if nch == 2:       # channel 1 immediately EOS: general path,
+            outs.extend(core.channel_eos(1))   # same stream semantics
+        for b in batches:
+            outs.extend(core.push(b, 0))
+        outs.extend(core.channel_eos(0))
+        outs.extend(core.flush())
+        allr = np.concatenate([o for o in outs if len(o)])
+        return np.sort(allr, order=["key", "id"])
+
+    fast, general = run(1), run(2)
+    np.testing.assert_array_equal(fast, general)
+    assert fast[MARKER_FIELD].sum() == 7   # markers replayed, renumbered

@@ -85,22 +85,35 @@ def expected(chunks) -> tuple[int, int]:
     return total, n_windows
 
 
-def run_once(chunks, pardegree, flush_rows, depth, capacity):
-    state = {"rcv": 0, "lat": 0.0, "total": 0}
+def run_once(chunks, pardegree, flush_rows, depth, capacity,
+             max_delay_ms=None, rate=None):
+    state = {"rcv": 0, "total": 0, "lat_us": []}
 
     def gen(shipper):
+        t0 = time.monotonic()
+        sent = 0
         for keys, ids, vals in chunks:
+            if rate:
+                # paced source (latency-budget mode): full-speed pushing
+                # stamps the whole stream up front and measures pipeline
+                # BACKLOG as "latency"; a sub-capacity pace keeps queues
+                # shallow so the p95 reflects window close-to-delivery
+                # delay, the thing a budget can govern
+                ahead = sent / rate - (time.monotonic() - t0)
+                if ahead > 0:
+                    time.sleep(ahead)
             now_us = int(time.time() * 1e6)
             shipper.push_batch(batch_from_columns(
                 SCHEMA, key=keys, id=ids,
                 ts=np.full(len(keys), now_us, dtype=np.int64), value=vals))
+            sent += len(keys)
 
     def consume(rows):
         if rows is None or not len(rows):
             return
         now_us = time.time() * 1e6
         state["rcv"] += len(rows)
-        state["lat"] += float((now_us - rows["ts"]).sum())
+        state["lat_us"].append((now_us - rows["ts"]).astype(np.float64))
         state["total"] += int(rows["value"].sum())
 
     # values after Map stay in [1, 3*VAL_HI]: declare it so the resident
@@ -118,7 +131,8 @@ def run_once(chunks, pardegree, flush_rows, depth, capacity):
             .chain(Filter(lambda b: keep(b["value"]), vectorized=True))
             .add(WinFarmTPU(red, WIN, SLIDE, WinType.CB,
                             pardegree=pardegree, batch_len=1 << 15,
-                            flush_rows=flush_rows, depth=depth))
+                            flush_rows=flush_rows, depth=depth,
+                            max_delay_ms=max_delay_ms))
             .chain_sink(Sink(consume, vectorized=True)))
     resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
@@ -128,13 +142,36 @@ def run_once(chunks, pardegree, flush_rows, depth, capacity):
     return dt, state, diag
 
 
+def _lat_stats(state):
+    from ..utils.latency import summarize
+    s = summarize(state["lat_us"], scale=1e-3)
+    if not s:
+        return {"avg_window_latency_ms": 0.0}
+    return {"avg_window_latency_ms": s["avg"],
+            "p95_window_latency_ms": s["p95"],
+            "p99_window_latency_ms": s["p99"]}
+
+
 def run(n_tuples=8_000_000, pardegree=2, chunk=1 << 20,
-        flush_rows=1 << 19, depth=24, capacity=4, runs=3):
+        flush_rows=1 << 19, depth=48, capacity=4, runs=3,
+        max_delay_ms=None, rate=None):
+    """Throughput mode (max_delay_ms=None) tunes for tuples/sec; the
+    LATENCY-BUDGET mode (max_delay_ms=B with a sub-capacity ``rate``)
+    bounds window close-to-delivery delay via the cores' force-flush
+    timers and reports the throughput achieved *within* the budget,
+    p95/p99 included — the reference's per-result latency is its
+    headline metric alongside throughput (ysb_nodes.hpp:231-246).
+    Without pacing, a finite full-speed drain's "latency" is queue
+    backlog, which no flush cadence can govern."""
+    if max_delay_ms is not None and chunk == 1 << 20:
+        # default chunk only: finer pacing granularity (~8 pushes/sec at
+        # 1M/s); an EXPLICIT --chunk is honored as given
+        chunk = 1 << 17
     chunks = make_values(n_tuples, chunk)
     want_total, want_windows = expected(chunks)
     # warmup (compiles every shape bucket) + the coalescing shape ladder,
     # on every device the farm's workers own (jit caches per placement)
-    run_once(chunks, pardegree, flush_rows, depth, capacity)
+    run_once(chunks, pardegree, flush_rows, depth, capacity, max_delay_ms)
     import jax
     devs = jax.devices()
     resident.prewarm_regular_ladder(devices=list(dict.fromkeys(
@@ -143,25 +180,33 @@ def run(n_tuples=8_000_000, pardegree=2, chunk=1 << 20,
     all_runs = []
     for _ in range(runs):
         dt, state, diag = run_once(chunks, pardegree, flush_rows, depth,
-                                   capacity)
+                                   capacity, max_delay_ms, rate)
         if state["total"] != want_total or state["rcv"] != want_windows:
             raise AssertionError(
                 f"pipe_test_tpu mismatch: sum {state['total']} != "
                 f"{want_total} or windows {state['rcv']} != {want_windows}")
-        r = {"tps": round(n_tuples / dt, 1),
-             "avg_window_latency_ms": round(
-                 state["lat"] / max(state["rcv"], 1) / 1e3, 2),
-             **diag}
+        r = {"tps": round(n_tuples / dt, 1), **_lat_stats(state), **diag}
+        if max_delay_ms is not None:
+            r["within_budget"] = bool(
+                r.get("p95_window_latency_ms", 0.0) <= max_delay_ms)
         all_runs.append(r)
         if best is None or r["tps"] > best["tps"]:
             best = r
+    if max_delay_ms is not None:
+        # the number of record under a latency budget is the fastest run
+        # whose p95 met it — a throughput-best that blew the budget is
+        # not an achievement in this mode
+        ok = [r for r in all_runs if r.get("within_budget")]
+        best = (max(ok, key=lambda r: r["tps"]) if ok else best)
     return {
         "metric": "pipe_test_tpu Source>Map>Filter>WinFarmTPU(x"
                   f"{pardegree})>Sink input tuples/sec (win={WIN} "
-                  f"slide={SLIDE} keys={N_KEYS}, {want_windows} windows)",
+                  f"slide={SLIDE} keys={N_KEYS}, {want_windows} windows"
+                  + (f", p95 budget {max_delay_ms} ms"
+                     if max_delay_ms is not None else "") + ")",
         "value": best["tps"],
         "unit": "tuples/sec",
-        "avg_window_latency_ms": best["avg_window_latency_ms"],
+        **{k: v for k, v in best.items() if k != "tps"},
         "runs": all_runs,
     }
 
@@ -176,12 +221,19 @@ def main(argv=None):
     # 40-43 dispatches / ~1.16M in identical weather (each dispatch costs
     # an amortized wire RTT; two farm workers halve the per-core cadence)
     ap.add_argument("--flush-rows", type=int, default=1 << 19)
-    ap.add_argument("--depth", type=int, default=24)
+    ap.add_argument("--depth", type=int, default=48)
     ap.add_argument("--capacity", type=int, default=4)
     ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--max-delay-ms", type=float, default=None,
+                    help="latency-budget mode: bound window "
+                         "close-to-delivery delay (force-flush timer) and "
+                         "report throughput within the p95 budget")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="paced source, tuples/sec (latency-budget mode "
+                         "needs a sub-capacity pace; default full speed)")
     a = ap.parse_args(argv)
     out = run(a.tuples, a.pardegree, a.chunk, a.flush_rows, a.depth,
-              a.capacity, a.runs)
+              a.capacity, a.runs, a.max_delay_ms, a.rate)
     print(json.dumps(out))
     return 0
 

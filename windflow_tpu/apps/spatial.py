@@ -335,16 +335,16 @@ class SpatialSink:
         self.lat_us.extend(int(v) for v in lat)
 
     def stats(self):
-        lat = np.asarray(self.lat_us, dtype=np.float64)
-        if not len(lat):
+        from ..utils.latency import summarize
+        s = summarize([np.asarray(self.lat_us, dtype=np.float64)],
+                      scale=1e-3)
+        if not s:
             return {"windows": 0}
         return {"windows": self.received,
                 "skyline_points": self.skyline_points,
-                "avg_latency_ms": round(float(lat.mean()) / 1e3, 2),
-                "p95_latency_ms": round(float(np.percentile(lat, 95)) / 1e3,
-                                        2),
-                "p99_latency_ms": round(float(np.percentile(lat, 99)) / 1e3,
-                                        2)}
+                "avg_latency_ms": s["avg"],
+                "p95_latency_ms": s["p95"],
+                "p99_latency_ms": s["p99"]}
 
 
 def build_spatial(variant: str, duration_sec: float, pardegree: int,

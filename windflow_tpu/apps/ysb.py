@@ -179,6 +179,10 @@ class YSBSink:
         self.on_result = on_result
         self.received = 0
         self.latency_sum_us = 0
+        self._lat_us = []   # per-result latencies -> p95/p99 (the
+        #                     reference's headline metric pair is
+        #                     throughput AND per-result latency,
+        #                     ysb_nodes.hpp:231-246)
 
     def __call__(self, batch):
         if batch is None:
@@ -190,6 +194,7 @@ class YSBSink:
         lat = now - (live["lastUpdate"] + self.start_wall_us)
         self.received += len(live)
         self.latency_sum_us += int(lat.sum())
+        self._lat_us.append(np.asarray(lat, dtype=np.float64))
         if self.on_result is not None:
             self.on_result(live)
 
@@ -197,11 +202,18 @@ class YSBSink:
     def avg_latency_us(self):
         return self.latency_sum_us / max(self.received, 1)
 
+    def latency_percentiles_us(self):
+        from ..utils.latency import summarize
+        s = summarize(self._lat_us, ndigits=1)
+        return ({"p95_latency_us": s["p95"], "p99_latency_us": s["p99"]}
+                if s else {})
+
 
 def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
                    pardegree2: int, win_sec: float = 10.0,
                    chunk: int = 262144, batches=None, on_result=None,
-                   opt_level: int = 0, force_device: bool = False):
+                   opt_level: int = 0, force_device: bool = False,
+                   max_delay_ms=None):
     """Assemble the YSB MultiPipe.  `variant`: 'kf' (test_ysb_kf) or 'wmr'
     (test_ysb_wmr).  Pass `batches` to override the timed generator with a
     deterministic list (tests)."""
@@ -242,7 +254,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
         from ..patterns.win_seq_tpu import KeyFarmTPU
         agg = KeyFarmTPU(device_aggregate(), win_us, win_us, WinType.TB,
                          pardegree=pardegree2, batch_len=256,
-                         name="ysb_kf_tpu",
+                         name="ysb_kf_tpu", max_delay_ms=max_delay_ms,
                          use_resident=True if force_device else None)
     elif variant == "wmr":
         agg = WinMapReduce(YSBAggregate(), YSBReduce(), win_us, win_us,
@@ -269,9 +281,18 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
                               win_us, WinType.TB,
                               map_degree=max(pardegree2, 2),
                               name="ysb_wmr_tpu", map_on_device=True,
-                              reduce_on_device=False, opt_level=opt_level)
+                              reduce_on_device=False, opt_level=opt_level,
+                              max_delay_ms=max_delay_ms)
     else:
         raise ValueError(f"unknown variant {variant!r}")
+    if max_delay_ms is not None and not variant.endswith("-tpu"):
+        # the host variants' windows close at watermark cadence with no
+        # device queueing — there is no flush timer to budget, and
+        # accepting the flag silently would let an operator read their
+        # latency numbers as budget-bounded when nothing bounded them
+        raise ValueError(
+            f"--max-delay-ms applies to device variants only (got "
+            f"{variant!r}: host windows have no device queue to bound)")
 
     pipe = (MultiPipe(f"ysb_{variant}")
             .add_source(Source(gen, EVENT_SCHEMA, parallelism=pardegree1,
@@ -317,7 +338,7 @@ def warmup(variant, pardegree1, pardegree2, win_sec, chunk,
 
 def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
         win_sec=10.0, chunk=262144, warm=None, opt_level=0,
-        force_device=False):
+        force_device=False, max_delay_ms=None):
     """Run the benchmark; returns the reference's four stdout metrics
     (test_ysb_kf.cpp:113-116)."""
     if warm is None:
@@ -330,7 +351,8 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
     pipe, sink, sent = build_pipeline(variant, duration_sec, pardegree1,
                                       pardegree2, win_sec, chunk,
                                       opt_level=opt_level,
-                                      force_device=force_device)
+                                      force_device=force_device,
+                                      max_delay_ms=max_delay_ms)
     from ..ops import resident
     resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
@@ -340,8 +362,17 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
         "generated": sent[0],
         "results": sink.received,
         "avg_latency_us": round(sink.avg_latency_us, 1),
+        **sink.latency_percentiles_us(),
         "elapsed_sec": round(elapsed, 3),
         "events_per_sec": round(sent[0] / elapsed, 1),
+        # sustained source-side rate DURING the generation window: the
+        # end-to-end events/sec above divides by elapsed incl. the EOS
+        # drain (device variants pay their in-flight launches' wire
+        # service there), while this measures what the pipeline ingests
+        # under backpressure while streaming — the steady-state capacity
+        # an infinite stream would see.  Both are reported; neither is
+        # the other's substitute.
+        "gen_events_per_sec": round(sent[0] / max(duration_sec, 1e-9), 1),
         # wire diagnostics (bench.py discipline): zeros on host-only
         # variants; on device variants they separate wire weather from
         # framework regressions
@@ -361,6 +392,9 @@ def main(argv=None):
                     default="kf")
     ap.add_argument("--win-sec", type=float, default=10.0)
     ap.add_argument("--chunk", type=int, default=262144)
+    ap.add_argument("--max-delay-ms", type=float, default=None,
+                    help="latency-budget mode: bound the device cores' "
+                         "queueing delay via their force-flush timers")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the compile warmup (device variants warm "
                          "by default; first XLA compiles take tens of "
@@ -376,12 +410,16 @@ def main(argv=None):
     a = ap.parse_args(argv)
     m = run(a.variant, a.length, a.pardegree1, a.pardegree2, a.win_sec,
             a.chunk, warm=False if a.no_warmup else None, opt_level=a.opt,
-            force_device=a.force_device)
+            force_device=a.force_device, max_delay_ms=a.max_delay_ms)
     print(f"[Main] Total generated messages are {m['generated']}")
     print(f"[Main] Total received results are {m['results']}")
     print(f"[Main] Latency (usec) {m['avg_latency_us']}")
+    if "p95_latency_us" in m:
+        print(f"[Main] Latency p95/p99 (usec) {m['p95_latency_us']} / "
+              f"{m['p99_latency_us']}")
     print(f"[Main] Total elapsed time (seconds) {m['elapsed_sec']}")
-    print(f"[Main] Events/sec {m['events_per_sec']}")
+    print(f"[Main] Events/sec {m['events_per_sec']} "
+          f"(ingest {m['gen_events_per_sec']})")
     return 0
 
 

@@ -80,6 +80,13 @@ def _bind(lib):
     lib.wf_core_force_flush.argtypes = [ctypes.c_void_p]
     lib.wf_core_set_flush_rows.restype = None
     lib.wf_core_set_flush_rows.argtypes = [ctypes.c_void_p, i64]
+    lib.wf_renum_new.restype = ctypes.c_void_p
+    lib.wf_renum_new.argtypes = []
+    lib.wf_renum_free.argtypes = [ctypes.c_void_p]
+    lib.wf_renum_run.restype = None
+    lib.wf_renum_run.argtypes = [ctypes.c_void_p, p_i64, i64, p_i64]
+    lib.wf_renum_next.restype = i64
+    lib.wf_renum_next.argtypes = [ctypes.c_void_p, i64]
     lib.wf_cores_process_mt.restype = i64
     lib.wf_cores_process_mt.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,

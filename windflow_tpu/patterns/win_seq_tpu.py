@@ -1016,12 +1016,14 @@ class WinMapReduceTPU(WinMapReduce):
                  name="win_mr_tpu", map_on_device=True,
                  reduce_on_device=False, batch_len=512, device=None,
                  depth=None, use_pallas=False, compute_dtype=None,
-                 use_resident=None, flush_rows=1 << 20, **kw):
+                 use_resident=None, flush_rows=1 << 20, max_delay_ms=None,
+                 **kw):
         self._on_device = {"map": map_on_device, "reduce": reduce_on_device}
         self._dev_kw = dict(batch_len=batch_len, device=device, depth=depth,
                             use_pallas=use_pallas,
                             compute_dtype=compute_dtype,
-                            use_resident=use_resident, flush_rows=flush_rows)
+                            use_resident=use_resident, flush_rows=flush_rows,
+                            max_delay_ms=max_delay_ms)
         super().__init__(map_func, reduce_func, win_len, slide_len, win_type,
                          map_degree=map_degree, reduce_degree=reduce_degree,
                          name=name, **kw)
