@@ -205,6 +205,32 @@ def main():
         host_err = f"{type(e).__name__}: {e}"
     if host_err:
         print(f"host-core control failed: {host_err}", file=sys.stderr)
+    # second control: the C++ bookkeeping + launch staging ALONE (queue
+    # never shipped) on the same stream — the device path's HOST-side
+    # ceiling on this box.  A capture whose device number approaches this
+    # is host-bound, not wire-bound: on the 1-core bench host the ship
+    # thread, engine and bookkeeping share one core, so this bound —
+    # not the wire — is what caps vs_baseline (measured r4: the 30M
+    # north star sits above it; see BASELINE.md round 4)
+    host_loop_tps = 0.0
+    try:
+        from windflow_tpu import native as _nat
+        _lib = _nat.load()
+        if _lib is not None:
+            b0 = batches[0]
+            f = b0.dtype.fields
+            offs = (b0.dtype.itemsize, f["key"][1], f["id"][1], f["ts"][1],
+                    f["marker"][1], f["value"][1])
+            h = _lib.wf_core_new(WIN, SLIDE, 0, 0, 0, 1, SLIDE, 0, 1,
+                                 SLIDE, 0, 1, SLIDE, BATCH_LEN, FLUSH_ROWS,
+                                 3)
+            t0 = time.perf_counter()
+            for b in batches:
+                _lib.wf_core_process(h, b.ctypes.data, len(b), *offs)
+            host_loop_tps = N_TUPLES / (time.perf_counter() - t0)
+            _lib.wf_core_free(h)
+    except Exception as e:  # noqa: BLE001 — diagnostic only
+        print(f"host-loop control failed: {e}", file=sys.stderr)
     print(json.dumps({
         "metric": "sum_test_tpu CB windowed-sum input tuples/sec "
                   f"(win={WIN} slide={SLIDE} keys={N_KEYS} "
@@ -224,6 +250,7 @@ def main():
         "best5_tps": round(best5, 1),
         "vs_baseline_best5": round(best5 / BASELINE_TUPLES_PER_SEC, 3),
         "host_core_tps": round(host_tps, 1),
+        "host_loop_tps": round(host_loop_tps, 1),
         **({"host_core_error": host_err} if host_err else {}),
         # the sampling rule is part of the artifact: extension triggers on
         # measured wire weather (exogenous), never on the score

@@ -16,11 +16,10 @@ import bench
 import numpy as np
 
 CONFIGS = [
-    {"dw": 4, "depth": 24},    # r3 default (anchor)
-    {"dw": 8, "depth": 48},
-    {"dw": 16, "depth": 48},
-    {"dw": 32, "depth": 48},
+    {"dw": 8, "depth": 48},                        # r4 default (anchor)
+    {"dw": 8, "depth": 48, "flush": 1 << 18},
     {"dw": 16, "depth": 96},
+    {"dw": 8, "depth": 48, "no_overlap": True},
 ]
 
 
@@ -41,21 +40,26 @@ def main():
     for r in range(rounds):
         for i, cfg in enumerate(CONFIGS):
             os.environ["WF_DISPATCH_WINDOW"] = str(cfg["dw"])
-            dt, _n, total, diag = _run(batches, schema, cfg["depth"])
+            if cfg.get("no_overlap"):
+                os.environ["WF_NO_OVERLAP"] = "1"
+            else:
+                os.environ.pop("WF_NO_OVERLAP", None)
+            dt, _n, total, diag = _run(batches, schema, cfg["depth"],
+                                       cfg.get("flush", bench.FLUSH_ROWS))
             assert total == want, (cfg, total, want)
             row = {"tps": round(bench.N_TUPLES / dt, 1), **diag}
             results[i].append(row)
-            print(f"round {r} dw={cfg['dw']} depth={cfg['depth']}: "
-                  f"{json.dumps(row)}", flush=True)
+            print(f"round {r} {cfg}: {json.dumps(row)}", flush=True)
     os.environ.pop("WF_DISPATCH_WINDOW", None)
+    os.environ.pop("WF_NO_OVERLAP", None)
     for i, cfg in enumerate(CONFIGS):
         tps = [x["tps"] for x in results[i]]
-        print(f"dw={cfg['dw']} depth={cfg['depth']}: best {max(tps):,.0f} "
+        print(f"{cfg}: best {max(tps):,.0f} "
               f"median {statistics.median(tps):,.0f} "
               f"dispatches {[x['dispatches'] for x in results[i]]}")
 
 
-def _run(batches, schema, depth):
+def _run(batches, schema, depth, flush_rows=None):
     import time
 
     from windflow_tpu.core.windows import WinType
@@ -76,7 +80,8 @@ def _run(batches, schema, depth):
 
     stage = WinSeqTPU(Reducer("sum", value_range=(0, 100)), bench.WIN,
                       bench.SLIDE, WinType.CB, batch_len=bench.BATCH_LEN,
-                      flush_rows=bench.FLUSH_ROWS, depth=depth, shards=1)
+                      flush_rows=flush_rows or bench.FLUSH_ROWS,
+                      depth=depth, shards=1)
     df = Dataflow()
     build_pipeline(df, [Source(batches=batches, schema=schema),
                         stage, Sink(consume, vectorized=True)])

@@ -481,7 +481,8 @@ def test_renumbering_single_channel_fast_path_matches_general():
     batches.append(mk)
 
     def run(nch):
-        core = OrderingCore(nch, OrderingMode.TS_RENUMBERING)
+        core = OrderingCore(nch, OrderingMode.TS_RENUMBERING,
+                            ordered_input=(nch == 1))
         outs = []
         if nch == 2:       # channel 1 immediately EOS: general path,
             outs.extend(core.channel_eos(1))   # same stream semantics

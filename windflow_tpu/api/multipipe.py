@@ -185,7 +185,8 @@ class MultiPipe:
         else:
             return tails, ordered, dense
         onode = OrderingNode(max(len(tails), 1), mode,
-                             name=f"{self.name}.order_merge")
+                             name=f"{self.name}.order_merge",
+                             ordered_input=(ordered and len(tails) == 1))
         df.add(onode)
         for t in tails:
             df.connect(t, onode)

@@ -178,11 +178,12 @@ class YSBSink:
         self.now_us = now_us or (lambda: int(time.time() * 1e6))
         self.on_result = on_result
         self.received = 0
-        self.latency_sum_us = 0
-        self._lat_us = []   # per-result latencies -> p95/p99 (the
+        self._lat_us = []   # per-result latencies -> avg/p95/p99 (the
         #                     reference's headline metric pair is
         #                     throughput AND per-result latency,
-        #                     ysb_nodes.hpp:231-246)
+        #                     ysb_nodes.hpp:231-246); avg derives from
+        #                     the same arrays as the percentiles so the
+        #                     two can never disagree
 
     def __call__(self, batch):
         if batch is None:
@@ -193,14 +194,15 @@ class YSBSink:
         now = self.now_us()
         lat = now - (live["lastUpdate"] + self.start_wall_us)
         self.received += len(live)
-        self.latency_sum_us += int(lat.sum())
         self._lat_us.append(np.asarray(lat, dtype=np.float64))
         if self.on_result is not None:
             self.on_result(live)
 
     @property
     def avg_latency_us(self):
-        return self.latency_sum_us / max(self.received, 1)
+        from ..utils.latency import summarize
+        s = summarize(self._lat_us, ndigits=1)
+        return s.get("avg", 0.0)
 
     def latency_percentiles_us(self):
         from ..utils.latency import summarize

@@ -210,8 +210,10 @@ class NativeResidentCore:
         # overlap mode: a dedicated ship thread owns the executors —
         # device_put/dispatch/harvest run concurrently with the next
         # chunk's C++ bookkeeping (the C++ launch queue is mutex-guarded
-        # for this producer/consumer split)
-        self._overlap = bool(overlap)
+        # for this producer/consumer split).  WF_NO_OVERLAP disables for
+        # sweeps (a 1-core host pays GIL contention for the overlap).
+        self._overlap = bool(overlap) and os.environ.get(
+            "WF_NO_OVERLAP", "") in ("", "0")
         self._ship_exc = None
         #: launches allowed to pile up in the C++ queue before process()
         #: throttles — restores the backpressure the synchronous ship loop

@@ -85,10 +85,18 @@ class OrderingCore:
     finished producers."""
 
     def __init__(self, n_channels: int, mode: OrderingMode,
-                 per_key_watermarks: bool = False):
+                 per_key_watermarks: bool = False,
+                 ordered_input: bool = False):
         self.n_channels = n_channels
         self.mode = mode
         self.per_key = per_key_watermarks
+        #: the caller vouches the (single) channel is ts-ordered per key
+        #: WITHIN each batch — the precondition for the renumbering fast
+        #: path.  A disordered single tail (TS_RENUMBERING chosen via
+        #: `not ordered`) must take the general path, whose per-release
+        #: stable ts-sort fixes intra-batch inversions before ids are
+        #: assigned.
+        self.ordered_input = bool(ordered_input)
         self.pos_field = "id" if mode is OrderingMode.ID else "ts"
         self._keys: dict[int, _KeyBuf] = {}
         #: channels that reached EOS (excluded from every key's min)
@@ -158,9 +166,12 @@ class OrderingCore:
         out = batch.copy()
         if self._renum is None and self._renum_lib is None:
             from ..native import load
-            self._renum_lib = load()
-            if self._renum_lib is not None:
-                self._renum = self._renum_lib.wf_renum_new()
+            lib = load()
+            # False = tried-and-unavailable sentinel: never re-attempt
+            # the load on this hot path
+            self._renum_lib = lib if lib is not None else False
+            if lib is not None:
+                self._renum = lib.wf_renum_new()
         if self._renum is not None:
             import ctypes
             p64 = ctypes.POINTER(ctypes.c_longlong)
@@ -212,6 +223,7 @@ class OrderingCore:
         if len(batch) == 0:
             return out
         if (self.n_channels == 1 and not self.per_key
+                and self.ordered_input
                 and self.mode is OrderingMode.TS_RENUMBERING):
             out.extend(self._push_single_channel(batch))
             return out
@@ -311,9 +323,11 @@ class OrderingCore:
 class OrderingNode(Node):
     """Standalone ordering node (multi-in)."""
 
-    def __init__(self, n_channels: int, mode: OrderingMode, name="ordering"):
+    def __init__(self, n_channels: int, mode: OrderingMode, name="ordering",
+                 ordered_input: bool = False):
         super().__init__(name)
-        self.core = OrderingCore(n_channels, mode)
+        self.core = OrderingCore(n_channels, mode,
+                                 ordered_input=ordered_input)
 
     def svc(self, batch, channel=0):
         for out in self.core.push(batch, channel):
