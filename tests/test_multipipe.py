@@ -496,3 +496,33 @@ def test_renumbering_single_channel_fast_path_matches_general():
     fast, general = run(1), run(2)
     np.testing.assert_array_equal(fast, general)
     assert fast[MARKER_FIELD].sum() == 7   # markers replayed, renumbered
+
+
+def test_renumbering_disordered_single_tail_keeps_general_path():
+    """A DISORDERED single tail must keep the general TS_RENUMBERING
+    path (per-release ts sort before ids are assigned): the r4 fast path
+    is gated on the caller vouching order — this pins both the gate and
+    the semantics it protects."""
+    import numpy as np
+
+    from windflow_tpu.core.tuples import Schema, batch_from_columns
+    from windflow_tpu.runtime.ordering import OrderingCore, OrderingMode
+
+    schema = Schema(value=np.int64)
+    # one batch with per-key ts INVERSIONS (keys interleaved, ts shuffled
+    # within each key)
+    keys = np.array([0, 1, 0, 1, 0, 1], dtype=np.int64)
+    ts = np.array([30, 10, 10, 30, 20, 20], dtype=np.int64)
+    b = batch_from_columns(schema, key=keys, id=np.arange(6), ts=ts,
+                           value=ts)
+
+    core = OrderingCore(1, OrderingMode.TS_RENUMBERING)  # not vouched
+    outs = list(core.push(b, 0))
+    outs.extend(core.channel_eos(0))
+    outs.extend(core.flush())
+    allr = np.sort(np.concatenate(outs), order=["key", "id"])
+    # ids must follow TS order per key (general-path semantics), so the
+    # value column (== ts) must be ascending per key after id-sort
+    for k in (0, 1):
+        vals = allr[allr["key"] == k]["value"]
+        assert list(vals) == sorted(vals), (k, list(vals))

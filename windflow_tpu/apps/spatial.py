@@ -427,6 +427,11 @@ def run(variant="wf", duration_sec=8.0, pardegree=2, win_ms=50.0,
     out = {"variant": variant, "generated": n_gen[0],
            "elapsed_sec": round(elapsed, 3),
            "events_per_sec": round(n_gen[0] / max(elapsed, 1e-9), 1),
+           # sustained ingest during the generation window (ysb.py's
+           # gen_events_per_sec twin): end-to-end divides by elapsed
+           # including the drain, this by the generation time only
+           "gen_events_per_sec": round(
+               n_gen[0] / max(duration_sec, 1e-9), 1),
            **sink.stats()}
     if variant == "wf-tpu":
         out.update({k: diag[k] for k in ("dispatches", "merges",

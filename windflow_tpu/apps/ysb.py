@@ -198,17 +198,19 @@ class YSBSink:
         if self.on_result is not None:
             self.on_result(live)
 
+    def latency_summary_us(self):
+        """One summarize() pass over the full latency history: avg and
+        percentiles derive from the same arrays, computed once."""
+        from ..utils.latency import summarize
+        s = summarize(self._lat_us, ndigits=1)
+        if not s:
+            return {"avg_latency_us": 0.0}
+        return {"avg_latency_us": s["avg"], "p95_latency_us": s["p95"],
+                "p99_latency_us": s["p99"]}
+
     @property
     def avg_latency_us(self):
-        from ..utils.latency import summarize
-        s = summarize(self._lat_us, ndigits=1)
-        return s.get("avg", 0.0)
-
-    def latency_percentiles_us(self):
-        from ..utils.latency import summarize
-        s = summarize(self._lat_us, ndigits=1)
-        return ({"p95_latency_us": s["p95"], "p99_latency_us": s["p99"]}
-                if s else {})
+        return self.latency_summary_us()["avg_latency_us"]
 
 
 def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
@@ -363,8 +365,7 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
     return {
         "generated": sent[0],
         "results": sink.received,
-        "avg_latency_us": round(sink.avg_latency_us, 1),
-        **sink.latency_percentiles_us(),
+        **sink.latency_summary_us(),
         "elapsed_sec": round(elapsed, 3),
         "events_per_sec": round(sent[0] / elapsed, 1),
         # sustained source-side rate DURING the generation window: the
