@@ -1,8 +1,9 @@
 """Interleaved A/B for proactive dispatch sizing (VERDICT r3 item 1).
 
 Alternates the headline bench workload with proactive flush sizing ON and
-OFF (WF_NO_PROACTIVE) in ONE process, so tunnel weather averages across
-arms — the only comparison shape the wire's ±2x swings permit
+OFF in ONE process, so tunnel weather averages across arms.  Proactive
+sizing is opt-in: arm "on" sets WF_PROACTIVE=1, arm "off" unsets it
+(native_core.py treats unset/"0"/"" as off) — the only comparison shape the wire's ±2x swings permit
 (BASELINE.md).  Prints per-run tps + wire diagnostics and per-arm
 best/median.
 

@@ -447,11 +447,14 @@ def test_builder_cuda_args_warn_and_ignore():
             .withScratchpad(64).build()
 
 
-def test_renumbering_single_channel_fast_path_matches_general():
+@pytest.mark.parametrize("use_native", [True, False])
+def test_renumbering_single_channel_fast_path_matches_general(use_native):
     """The single-upstream TS_RENUMBERING fast path (arrival-order
     vectorised/native cumcount, no pos argsort) must be row-identical to
     the general merge path, markers included (r4: the general path was
-    the pipe benchmark's largest host cost)."""
+    the pipe benchmark's largest host cost).  use_native=False pins the
+    numpy groupby-cumcount fallback, which on a normally-built checkout
+    never runs otherwise (ADVICE r4)."""
     import numpy as np
 
     from windflow_tpu.core.tuples import (MARKER_FIELD, Schema,
@@ -483,6 +486,9 @@ def test_renumbering_single_channel_fast_path_matches_general():
     def run(nch):
         core = OrderingCore(nch, OrderingMode.TS_RENUMBERING,
                             ordered_input=(nch == 1))
+        if nch == 1 and not use_native:
+            core._renum_lib = False    # tried-and-unavailable sentinel
+        run.core = core if nch == 1 else getattr(run, "core", None)
         outs = []
         if nch == 2:       # channel 1 immediately EOS: general path,
             outs.extend(core.channel_eos(1))   # same stream semantics
@@ -496,6 +502,10 @@ def test_renumbering_single_channel_fast_path_matches_general():
     fast, general = run(1), run(2)
     np.testing.assert_array_equal(fast, general)
     assert fast[MARKER_FIELD].sum() == 7   # markers replayed, renumbered
+    if use_native:
+        # a checkout without the built native lib would silently degrade
+        # this arm to the fallback the other arm already pins
+        assert run.core._renum is not None, "native renum lib not built"
 
 
 def test_renumbering_disordered_single_tail_keeps_general_path():

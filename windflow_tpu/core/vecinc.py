@@ -31,6 +31,8 @@ bookkeeping is O(rows log rows) regardless of key cardinality.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .slots import segments as _segments
@@ -594,6 +596,11 @@ class VecIncSlidingCore(VecIncTumblingCore):
 
 #: derived crossover cache, keyed by window shape — measured on THIS host
 _SLIDING_THRESHOLD = {}
+#: serialises the calibration benchmark: several farm workers
+#: constructing LazySlidingCores concurrently would otherwise each run
+#: the measurement under mutual contention and fit a skewed crossover
+#: (ADVICE r4); the winner publishes the cached value the rest reuse
+_THRESHOLD_LOCK = threading.Lock()
 
 
 def derived_sliding_threshold(spec: WindowSpec = None,
@@ -614,6 +621,13 @@ def derived_sliding_threshold(spec: WindowSpec = None,
     ck = (int(spec.win_len), int(spec.slide_len))
     if ck in _SLIDING_THRESHOLD and not force:
         return _SLIDING_THRESHOLD[ck]
+    with _THRESHOLD_LOCK:
+        if ck in _SLIDING_THRESHOLD and not force:
+            return _SLIDING_THRESHOLD[ck]
+        return _measure_sliding_threshold(ck)
+
+
+def _measure_sliding_threshold(ck) -> int:
     import time as _t
 
     from .tuples import Schema, batch_from_columns
