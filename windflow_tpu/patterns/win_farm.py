@@ -55,10 +55,14 @@ class WFEmitterNode(Node):
         batch = self._state.filter(batch)
         if len(batch) == 0:
             return
-        pos = batch[self.pos_field].astype(np.int64)
+        pos = self._state.pos_cache   # contiguous copy filter already made
+        if pos is None:
+            pos = batch[self.pos_field].astype(np.int64)
         keys = batch["key"]
-        init = self._initial_id(keys)
-        rel = pos - init
+        if self.n_outer == 1:
+            rel = pos          # non-nested: _initial_id is identically 0
+        else:
+            rel = pos - self._initial_id(keys)
         keep = rel >= 0
         if spec.is_hopping:
             keep &= spec.in_any_window(np.maximum(rel, 0))
@@ -72,8 +76,17 @@ class WFEmitterNode(Node):
         first_w = spec.first_win_containing(rel)
         last_w = spec.last_win_containing(rel)
         count = last_w - first_w + 1
-        start_dst = keys % self.pardegree
         n = self.pardegree
+        # steady state of sliding windows (win > slide): every row belongs
+        # to >= pardegree windows, so every worker gets every row — detect
+        # it once and multicast the SAME array instead of gathering a full
+        # copy per worker (workers only read; ~2x the stream size saved
+        # per batch on the pipe benchmark)
+        if count.min() >= n:
+            for d in range(n):
+                self.emit_to(d, batch)
+            return
+        start_dst = (keys & (n - 1)) if n & (n - 1) == 0 else keys % n
         for d in range(n):
             # worker d gets the row iff some w in [first, first+min(count,n))
             # satisfies (key%n + w) % n == d

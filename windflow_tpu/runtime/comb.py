@@ -45,6 +45,13 @@ class Comb(Node):
         self.stages = list(stages)
         for a, b in zip(self.stages, self.stages[1:]):
             a._outputs = [(_SyncOut(b), 0)]
+            # fused edges are direct handoffs: a stage whose producer
+            # yields fresh batches may mutate them in place (node.py
+            # ownership protocol) — this is where the per-edge proof
+            # happens, since inside a Comb the producer is known
+            b.input_fresh = bool(a.yields_fresh)
+        #: the Comb hands downstream whatever its last stage emits
+        self.yields_fresh = bool(self.stages[-1].yields_fresh)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -40,7 +40,22 @@ class RuntimeContext:
 class Node:
     """Base dataflow node. Subclasses override `svc` (and optionally the
     lifecycle hooks). During execution `self._outputs` holds the output
-    channels and `self.ctx` the RuntimeContext."""
+    channels and `self.ctx` the RuntimeContext.
+
+    Batch-ownership protocol (copy elision): batches are logically
+    immutable once emitted — the race-safety model — but a node whose
+    every emission is a freshly allocated array it never touches again
+    declares ``yields_fresh = True``, transferring ownership downstream.
+    A consumer whose ``input_fresh`` was set by the wiring layer (Comb
+    fusion, or MultiPipe's ordering interposition) may then mutate the
+    batch in place instead of taking a private copy — the reference's
+    in-place Map flavour (map.hpp:141) generalised to every handed-off
+    edge.  Both default to False: unknown producers are shared."""
+
+    #: every batch this node emits is newly allocated and never reused
+    yields_fresh = False
+    #: the wiring layer proved this node's input batches are handed off
+    input_fresh = False
 
     def __init__(self, name: str = None):
         self.name = name or type(self).__name__
