@@ -303,9 +303,13 @@ def test_max_delay_flushes_partial_batches():
     import time as _time
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
+        # use_resident=True pins the DEVICE path: this test covers the
+        # device cores' force-flush timer, and the budget-aware routing
+        # would otherwise (correctly) send a 1 ms budget to the host
+        # core once any earlier test seeded the global weather record
         core = make_core_for(WindowSpec(4, 4, WinType.CB), Reducer("sum"),
                              batch_len=1 << 20, flush_rows=1 << 20,
-                             max_delay_ms=1)
+                             max_delay_ms=1, use_resident=True)
     b1 = cb_stream(1, 8, chunk=8)[0]
     got = core.process(b1)          # windows fire internally, none shipped
     _time.sleep(0.01)

@@ -375,7 +375,12 @@ class NativeResidentCore:
             _res.stats_max("flush_mult_max", self._flush_mult)
             svc = max(ex.mean_service_s() for ex in self.executors)
             if svc > 0.0:
-                _res.note_wire_service_ms(1e3 * svc)
+                # the global weather is fed per harvested launch
+                # (resident._note_service, always-on) — folding the
+                # chunk-cadence MEAN here again would both double-feed
+                # the EMA and flood the 16-slot floor window with mean
+                # values, evicting the genuine fast-launch minima the
+                # budget routing keys on
                 desired = _pick_flush_mult(_res.wire_weather_ms())
                 if desired != self._flush_mult:
                     self._flush_mult = desired

@@ -744,6 +744,41 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
     the resident executor evaluates; segment-restaging otherwise.  With
     ``mesh`` the resident ring is sharded ``P('kf', None)`` across the mesh
     devices (one dispatch serves every key group over ICI)."""
+    def _host_core():
+        from .win_seq import WinSeq
+        return WinSeq(winfunc, spec.win_len, spec.slide_len,
+                      spec.win_type, config=config, role=role,
+                      map_indexes=map_indexes,
+                      result_ts_slide=result_ts_slide).make_core()
+
+    if (max_delay_ms is not None and use_resident is None
+            and mesh is None and not use_pallas
+            and isinstance(winfunc, (Reducer, MultiReducer))
+            # a MultiReducer invalid on EVERY device path must fall
+            # through to the deterministic ValueError below — routing it
+            # host only when some earlier run seeded the weather record
+            # would make raise-vs-success depend on hidden global state
+            and not (isinstance(winfunc, MultiReducer)
+                     and not _multi_resident_ok(winfunc, use_pallas))):
+        # budget-aware routing (VERDICT r4 item 4): every device result
+        # pays at least one wire round-trip, so a latency budget under
+        # ~2x the MEASURED per-launch service is unmeetable on the
+        # device path by construction (the r4 YSB --max-delay-ms 250
+        # run: force-flushing took avg 2.54 s -> 0.47 s but p95 stayed
+        # 1.49 s against 700 ms launches).  The host core has no wire
+        # in its path and meets double-digit-ms budgets today.  The
+        # statistic is the recent-best service FLOOR, not the EMA: a
+        # warmup run's compile launches inflate the mean (measured 915
+        # ms EMA against a ~200 ms floor), and feasibility is about the
+        # wire's best, not its average.  The record outlives executors
+        # (ops/resident.py), so a warmup teaches the routing what this
+        # session's tunnel can do; with no observation yet the device
+        # keeps the benefit of the doubt.  ANY explicit path pin —
+        # use_resident=True/False, use_pallas — outranks the heuristic.
+        from ..ops.resident import wire_service_floor_ms
+        floor = wire_service_floor_ms()
+        if floor is not None and max_delay_ms < 2.0 * floor:
+            return _host_core()
     if (isinstance(winfunc, (Reducer, MultiReducer))
             and use_resident is None and mesh is None
             and (isinstance(winfunc, MultiReducer) or not use_pallas)
@@ -757,11 +792,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
         # a Reducer with use_pallas=True keeps the Pallas/restaging path
         # (benchmarking) — MultiReducer has no Pallas path, so the flag
         # does not block its host routing.
-        from .win_seq import WinSeq
-        return WinSeq(winfunc, spec.win_len, spec.slide_len,
-                      spec.win_type, config=config, role=role,
-                      map_indexes=map_indexes,
-                      result_ts_slide=result_ts_slide).make_core()
+        return _host_core()
     if isinstance(winfunc, MultiReducer):
         # multi-stat windows are resident-only (the restaging executor has
         # no multi-output contract); count-only MultiReducers should be a
