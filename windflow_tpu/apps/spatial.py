@@ -300,15 +300,25 @@ def spatial_event_batches(duration_sec: float, chunk: int,
         now = time_fn() - t0
         if now >= duration_sec:
             return
-        ahead = v0 / rate - now          # seconds of lead over the pace
+        # pace to the chunk's LAST tuple: emitting when only the first id
+        # is due would hand downstream tuples stamped up to chunk/rate in
+        # the FUTURE of the wall clock, closing windows before their end
+        # time and understating measured latency by that much
+        ahead = (v0 + chunk) / rate - now    # seconds of lead over the pace
         if ahead > 0:
             sleep_fn(min(ahead, duration_sec - now))
             now = time_fn() - t0
             if now >= duration_sec:
                 return
         ids = np.arange(v0, v0 + chunk, dtype=np.int64)
+        # per-tuple event time from the pace (tuple v is generated at
+        # ~v/rate seconds): one shared wall stamp per chunk makes every
+        # chunk a single 0-width ts point, so whole PANES land on one
+        # farm worker in ~chunk-cadence beats and a worker's open pane
+        # cannot close until the alternation returns (~0.5 s of pure
+        # artifact latency measured at rate 1250 / chunk 64)
         yield _pt_batch(ids, ids % keys,
-                        np.full(chunk, int(now * 1e6), dtype=np.int64),
+                        (ids * (1e6 / rate)).astype(np.int64),
                         rng.uniform(0, 100, chunk),
                         rng.uniform(0, 100, chunk))
         v0 += chunk
@@ -350,7 +360,7 @@ class SpatialSink:
 def build_spatial(variant: str, duration_sec: float, pardegree: int,
                   win_ms: float, slide_ms: float, chunk: int,
                   rate: float = 80_000.0, batches=None,
-                  batch_len: int = 256):
+                  batch_len: int = 256, max_delay_ms: float = None):
     """Assemble one spatial composition.  `variant`: 'wf' (whole-window
     skyline through Win_Farm, test_spatial_wf.cpp), 'pf' (pane
     decomposition, test_spatial_pf.cpp), 'nested' (WF(PF)), 'wf-tpu'
@@ -382,9 +392,16 @@ def build_spatial(variant: str, duration_sec: float, pardegree: int,
         from ..patterns.win_seq_tpu import WinFarmTPU
         agg = WinFarmTPU(device_skyline(), win_us, slide_us, WinType.TB,
                          pardegree=pardegree, batch_len=batch_len,
-                         use_resident=True, name="sky_wf_tpu")
+                         use_resident=True, name="sky_wf_tpu",
+                         max_delay_ms=max_delay_ms)
     else:
         raise ValueError(f"unknown spatial variant {variant!r}")
+    if max_delay_ms is not None and variant != "wf-tpu":
+        # same guard as ysb.py: the host variants have no force-flush
+        # timer — silently printing their latencies as "budget-bounded"
+        # would misreport what bounded them (nothing)
+        raise ValueError("--max-delay-ms applies to the wf-tpu variant "
+                         f"only (got {variant!r})")
 
     start_wall = int(_time.time() * 1e6)
     sink = SpatialSink(start_wall)
@@ -405,7 +422,8 @@ def build_spatial(variant: str, duration_sec: float, pardegree: int,
 
 
 def run(variant="wf", duration_sec=8.0, pardegree=2, win_ms=50.0,
-        slide_ms=12.5, chunk=2048, rate=80_000.0, warm=True):
+        slide_ms=12.5, chunk=2048, rate=80_000.0, warm=True,
+        max_delay_ms=None):
     """Run one spatial benchmark variant; returns the reference's metric
     pair (events/sec + per-window latency) with wire diagnostics."""
     from ..ops import resident
@@ -413,12 +431,14 @@ def run(variant="wf", duration_sec=8.0, pardegree=2, win_ms=50.0,
         # short warm pass: compiles the device buckets (wf-tpu) and
         # first-touches every composition path outside the timed window
         wp, _ws, _wn = build_spatial(variant, 1.0, pardegree, win_ms,
-                                     slide_ms, chunk, rate)
+                                     slide_ms, chunk, rate,
+                                     max_delay_ms=max_delay_ms)
         wp.run_and_wait_end()
         if variant == "wf-tpu":
             resident.prewarm_regular_ladder()
     pipe, sink, n_gen = build_spatial(variant, duration_sec, pardegree,
-                                      win_ms, slide_ms, chunk, rate)
+                                      win_ms, slide_ms, chunk, rate,
+                                      max_delay_ms=max_delay_ms)
     resident.stats_snapshot(reset=True)
     t0 = _time.perf_counter()
     pipe.run_and_wait_end()
@@ -454,13 +474,62 @@ def main(argv=None):
                          "= rate * win)")
     ap.add_argument("--rounds", type=int, default=2,
                     help="interleaved rounds per variant (weather fairness)")
+    ap.add_argument("--budget-ms", type=float, default=None,
+                    help="sustainable-throughput mode: step through "
+                         "--rates ascending per variant and report the "
+                         "highest rate whose p95 window latency meets "
+                         "this budget (the streaming-benchmark "
+                         "methodology; saturation latencies at a "
+                         "too-fast pace are queue backlog, not service)")
+    ap.add_argument("--rates", default="2500,5000,10000,20000,40000,80000",
+                    help="ascending rate ladder for --budget-ms mode")
+    ap.add_argument("--max-delay-ms", type=float, default=None,
+                    help="device-core force-flush bound (wf-tpu); "
+                         "defaults to budget/2 in --budget-ms mode")
     a = ap.parse_args(argv)
     variants = [v.strip() for v in a.variants.split(",") if v.strip()]
+    if a.budget_ms is not None:
+        # sustainable throughput under a latency budget (VERDICT r4
+        # item 5): per variant, climb the rate ladder while p95 meets
+        # the budget; a first violation ends that variant's climb (the
+        # saturated regime only gets worse with rate)
+        rates = [float(r) for r in a.rates.split(",") if r.strip()]
+        for v in variants:
+            dly = a.max_delay_ms
+            if dly is None and v == "wf-tpu":
+                dly = a.budget_ms / 2
+            best = None
+            for r in rates:
+                # chunk ~ one slide period of points: at 2.5k pts/s the
+                # default 2048-chunk takes 0.8 s to FILL — pure source
+                # batching delay that would dominate any budget
+                chunk = min(a.chunk, max(64, int(r * a.slide_ms / 1e3)))
+                # wf-tpu re-warms at every rung: window cardinality grows
+                # with rate (32x across the default ladder), and a cold
+                # device-shape compile inside the timed window would end
+                # the climb on compile latency, not saturation
+                out = run(v, a.length, a.pardegree, a.win_ms, a.slide_ms,
+                          chunk, r, warm=(best is None or v == "wf-tpu"),
+                          max_delay_ms=dly)
+                out["rate"] = r
+                out["within_budget"] = bool(
+                    out.get("p95_latency_ms", float("inf")) <= a.budget_ms)
+                print(json.dumps(out), flush=True)
+                if not out["within_budget"]:
+                    break
+                best = out
+            print(json.dumps({
+                "metric": f"spatial_test {v} sustainable@p95<="
+                          f"{a.budget_ms:g}ms",
+                **(best or {"rate": 0, "note": "no rate met the budget"}),
+            }), flush=True)
+        return 0
     rows = {v: [] for v in variants}
     for _ in range(a.rounds):
         for v in variants:
             out = run(v, a.length, a.pardegree, a.win_ms, a.slide_ms,
-                      a.chunk, a.rate, warm=not rows[v])
+                      a.chunk, a.rate, warm=not rows[v],
+                      max_delay_ms=a.max_delay_ms)
             rows[v].append(out)
             print(json.dumps(out), flush=True)
     for v in variants:
