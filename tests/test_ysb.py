@@ -176,3 +176,24 @@ def test_ysb_wmr_tpu_differential():
     a, _, _ = run_variant("kf")
     b, _, _ = run_variant("wmr-tpu")
     assert sorted(a.rows) == sorted(b.rows)
+
+
+def test_rich_stats_routes_to_multifield_executor():
+    """device_aggregate(rich=True) must keep selecting the single-device
+    multi-field resident path: MIN(ts) is real device work on the ts
+    ring (not answerable by the pos-max split), making the device half
+    two fields.  Pins the routing BASELINE.md's real-chip row documents."""
+    import warnings
+
+    from windflow_tpu.apps.ysb import device_aggregate
+    from windflow_tpu.core.windows import WindowSpec, WinType
+    from windflow_tpu.ops.resident import MultiFieldResidentExecutor
+    from windflow_tpu.patterns.win_seq_tpu import make_core_for
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(WindowSpec(10_000_000, 10_000_000, WinType.TB),
+                             device_aggregate(rich=True), batch_len=256)
+    ex = getattr(core, "executor", None)
+    assert isinstance(ex, MultiFieldResidentExecutor)
+    assert set(ex.fields) == {"revenue", "ts"}
