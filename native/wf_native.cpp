@@ -41,6 +41,11 @@ static const i64 kCoalesceLadderMax = 16;
 // absolute ring budget (KP * cap cells): 2^25 int32 cells = 128 MB of
 // HBM per core — deep-merge provisioning backs off before exceeding it
 static const i64 kMaxRingCells = 1LL << 25;
+// multi-field staging bound: a core stages up to this many int64 payload
+// columns per row (one device ring per field — ops/resident.py
+// MultiFieldResidentExecutor); richer aggregates fall back to the Python
+// core.  4 covers every tracked workload (YSB --rich-stats ships 2).
+static const int kMaxFields = 4;
 
 static inline i64 bucket(i64 n, i64 lo = 8) {
     i64 b = lo;
@@ -69,10 +74,25 @@ namespace {
 enum Role { SEQ = 0, PLQ = 1, WLQ = 2, MAP = 3, REDUCE = 4 };
 enum WinKind { CB = 0, TB = 1 };
 
+// int64 column -> wire-dtype rectangle row (the H2D payload narrowing)
+static inline void copy_narrow(u8 *dst, const i64 *src, i64 cnt, int wire) {
+    if (wire == 0)
+        for (i64 c = 0; c < cnt; ++c) ((int8_t *)dst)[c] = (int8_t)src[c];
+    else if (wire == 1)
+        for (i64 c = 0; c < cnt; ++c) ((int16_t *)dst)[c] = (int16_t)src[c];
+    else if (wire == 2)
+        for (i64 c = 0; c < cnt; ++c) ((int32_t *)dst)[c] = (int32_t)src[c];
+    else
+        std::memcpy(dst, src, (size_t)cnt * 8);
+}
+
 struct KeyState {
     // live archive: SoA ordered by pos, purge advances `start`
     // (core/archive.py's KeyArchive, reference stream_archive.hpp)
     std::vector<i64> pos, ts, val;
+    // extra payload columns (fields 1..F-1 of a multi-field core);
+    // empty on the default single-field cores so per-key memory stays flat
+    std::vector<std::vector<i64>> xval;
     size_t start = 0;
     i64 appended = 0;      // rows ever archived (absolute row domain)
     i64 launched = 0;      // rows already shipped to the device ring
@@ -82,17 +102,32 @@ struct KeyState {
     i64 next_lwid = 0, n_fired = 0, emit_counter = 0;
     i64 marker_pos = NEG_INF, marker_ts = 0;
     i64 purge_pos = NEG_INF;  // purge deferred to flush (rebase invariant)
-    // value range of UNSHIPPED rows, tracked at append time so flush()'s
-    // wire-dtype choice needs no re-scan of the pending rows
-    i64 pend_vmin = 0, pend_vmax = 0;
+    // per-field value range of UNSHIPPED rows, tracked at append time so
+    // flush()'s wire-dtype choice needs no re-scan of the pending rows
+    i64 pend_vmin[kMaxFields] = {0}, pend_vmax[kMaxFields] = {0};
     bool pend_any = false;
     int row = -1;             // dense ring row
 
-    inline void note_val(i64 v) {
-        if (!pend_any) { pend_vmin = pend_vmax = v; pend_any = true; }
-        else {
-            if (v < pend_vmin) pend_vmin = v;
-            if (v > pend_vmax) pend_vmax = v;
+    inline void note_vals(int nf, const i64 *vs) {
+        if (!pend_any) {
+            for (int f = 0; f < nf; ++f) pend_vmin[f] = pend_vmax[f] = vs[f];
+            pend_any = true;
+            return;
+        }
+        for (int f = 0; f < nf; ++f) {
+            if (vs[f] < pend_vmin[f]) pend_vmin[f] = vs[f];
+            if (vs[f] > pend_vmax[f]) pend_vmax[f] = vs[f];
+        }
+    }
+    // block-range over-approximation, field 0 (the single-field bulk path)
+    inline void note_range0(i64 lo, i64 hi) {
+        if (!pend_any) {
+            pend_vmin[0] = lo;
+            pend_vmax[0] = hi;
+            pend_any = true;
+        } else {
+            if (lo < pend_vmin[0]) pend_vmin[0] = lo;
+            if (hi > pend_vmax[0]) pend_vmax[0] = hi;
         }
     }
     // hot-loop threshold caches (derived from next_lwid / n_fired; kept
@@ -113,6 +148,8 @@ struct KeyState {
             pos.erase(pos.begin(), pos.begin() + start);
             ts.erase(ts.begin(), ts.begin() + start);
             val.erase(val.begin(), val.begin() + start);
+            for (auto &xv : xval)
+                xv.erase(xv.begin(), xv.begin() + start);
             start = 0;
         }
     }
@@ -131,7 +168,12 @@ struct Launch {
     i64 cmax = 0;
     int mult = 1;   // coalescing multiplicity (buddy scheme: 1, 2, 4, ...)
     std::vector<int32_t> rcount, rstart0, rlen, widx;   // K, K, K, B
-    std::vector<u8> blk;              // K*R in wire dtype
+    std::vector<u8> blk;              // K*R in wire dtype (field 0)
+    // fields 1..F-1 of a multi-field core: one rectangle + wire dtype
+    // each (field 0 stays in blk/wire so the single-field ABI and every
+    // existing consumer are untouched)
+    std::vector<std::vector<u8>> xblk;
+    int xwire[kMaxFields] = {0};
     std::vector<i64> offs;            // K ring write offsets
     std::vector<int32_t> rows;        // K per-key valid row counts in blk
     std::vector<int32_t> wrows, wstarts, wlens;   // B window descriptors
@@ -149,6 +191,11 @@ struct Core {
     i64 map_idx0, map_idx1, result_ts_slide;
     i64 batch_len, flush_rows;
     int max_wire;   // widest wire dtype: 2=int32 (default), 3=int64
+    // multi-field staging (wf_core_set_fields): number of payload columns
+    // and each field's widest admissible wire dtype (max_wire_f[0] shadows
+    // max_wire so the per-field logic has one source of truth)
+    int n_fields = 1;
+    int max_wire_f[kMaxFields];
     bool hopping;
 
     std::unordered_map<i64, int> rowmap;
@@ -179,7 +226,9 @@ struct Core {
           id_inner(ii), n_inner(ni), slide_inner(si),
           map_idx0(m0), map_idx1(m1), result_ts_slide(rts),
           batch_len(bl), flush_rows(fr), max_wire(mw),
-          hopping(slide_ > win_), direct(4096, -1) {}
+          hopping(slide_ > win_), direct(4096, -1) {
+        for (int f = 0; f < kMaxFields; ++f) max_wire_f[f] = mw;
+    }
 
     KeyState &state(i64 key) {
         int r;
@@ -197,6 +246,7 @@ struct Core {
         keys.emplace_back();
         KeyState &st = keys.back();
         st.row = r;
+        if (n_fields > 1) st.xval.resize((size_t)(n_fields - 1));
         // farm distribution math (windows.py PatternConfig,
         // reference win_seq.hpp:307-314)
         i64 a = pymod(id_inner - pymod(key, n_inner), n_inner);
@@ -305,10 +355,13 @@ struct Core {
             // by this provisioning (r2: the fixed 2*slack stopped the
             // ladder at ~2x).  room_mult grows on ring-full rebases above,
             // bounded by the absolute ring budget.
+            // the ring budget is per CORE: a multi-field core allocates
+            // one (KP, cap) device ring per field, so each field's share
+            // of the cell budget shrinks accordingly
             while (room_mult > 2
                    && KPb * bucket(std::max<i64>(
                           2 * maxlive + room_mult * slack, 16))
-                          > kMaxRingCells)
+                          > kMaxRingCells / n_fields)
                 room_mult /= 2;
             cap = bucket(std::max<i64>(2 * maxlive + room_mult * slack, 16));
             R = maxlive;
@@ -319,21 +372,29 @@ struct Core {
         } else {
             R = maxpend;
         }
-        // narrowest wire dtype over the rows to ship.  Steady state uses
-        // the per-key ranges tracked at append time (no re-scan); a
-        // REBASE re-ships every live row — including previously shipped
-        // ones outside the pending range — so it must scan the actual
-        // ship range or wide old values would truncate into a narrow wire
+        // narrowest wire dtype PER FIELD over the rows to ship.  Steady
+        // state uses the per-key ranges tracked at append time (no
+        // re-scan); a REBASE re-ships every live row — including
+        // previously shipped ones outside the pending range — so it must
+        // scan the actual ship range or wide old values would truncate
+        // into a narrow wire
         bool anyv = false;
-        i64 vmin = 0, vmax = 0;
+        i64 vmin[kMaxFields] = {0}, vmax[kMaxFields] = {0};
         if (rebase) {
             for (auto &st : keys) {
                 for (size_t j = st.start; j < st.pos.size(); ++j) {
-                    const i64 v = st.val[j];
-                    if (!anyv) { vmin = vmax = v; anyv = true; }
-                    else {
-                        if (v < vmin) vmin = v;
-                        if (v > vmax) vmax = v;
+                    if (!anyv) {
+                        vmin[0] = vmax[0] = st.val[j];
+                        for (int f = 1; f < n_fields; ++f)
+                            vmin[f] = vmax[f] = st.xval[(size_t)(f - 1)][j];
+                        anyv = true;
+                        continue;
+                    }
+                    for (int f = 0; f < n_fields; ++f) {
+                        const i64 v = f == 0 ? st.val[j]
+                                             : st.xval[(size_t)(f - 1)][j];
+                        if (v < vmin[f]) vmin[f] = v;
+                        if (v > vmax[f]) vmax[f] = v;
                     }
                 }
             }
@@ -341,24 +402,39 @@ struct Core {
             for (auto &st : keys) {
                 if (!st.pend_any) continue;
                 if (!anyv) {
-                    vmin = st.pend_vmin;
-                    vmax = st.pend_vmax;
+                    for (int f = 0; f < n_fields; ++f) {
+                        vmin[f] = st.pend_vmin[f];
+                        vmax[f] = st.pend_vmax[f];
+                    }
                     anyv = true;
                 } else {
-                    vmin = std::min(vmin, st.pend_vmin);
-                    vmax = std::max(vmax, st.pend_vmax);
+                    for (int f = 0; f < n_fields; ++f) {
+                        vmin[f] = std::min(vmin[f], st.pend_vmin[f]);
+                        vmax[f] = std::max(vmax[f], st.pend_vmax[f]);
+                    }
                 }
             }
         }
         Launch L;
-        if (!anyv || (vmin >= -128 && vmax <= 127)) L.wire = 0;
-        else if (vmin >= -32768 && vmax <= 32767) L.wire = 1;
-        else if (max_wire <= 2 || (vmin >= INT32_MIN && vmax <= INT32_MAX))
-            L.wire = 2;
-        else L.wire = 3;   // int64 wire (64-bit accumulate dtype)
-        const i64 isz = 1LL << L.wire;
+        for (int f = 0; f < n_fields; ++f) {
+            int w;
+            if (!anyv || (vmin[f] >= -128 && vmax[f] <= 127)) w = 0;
+            else if (vmin[f] >= -32768 && vmax[f] <= 32767) w = 1;
+            else if (max_wire_f[f] <= 2
+                     || (vmin[f] >= INT32_MIN && vmax[f] <= INT32_MAX))
+                w = 2;
+            else w = 3;   // int64 wire (64-bit accumulate dtype)
+            L.xwire[f] = w;
+        }
+        L.wire = L.xwire[0];
         const i64 Rr = std::max<i64>(R, 1);
-        L.blk.assign((size_t)(K * Rr * isz), 0);
+        L.blk.assign((size_t)(K * Rr) << L.wire, 0);
+        if (n_fields > 1) {
+            L.xblk.resize((size_t)(n_fields - 1));
+            for (int f = 1; f < n_fields; ++f)
+                L.xblk[(size_t)(f - 1)].assign(
+                    (size_t)(K * Rr) << L.xwire[f], 0);
+        }
         L.offs.assign((size_t)K, 0);
         L.rows.assign((size_t)K, 0);
         for (auto &st : keys) {
@@ -367,19 +443,13 @@ struct Core {
             i64 cnt = (i64)(st.pos.size() - j0);
             L.offs[(size_t)st.row] = st.launched - st.ring_base;
             L.rows[(size_t)st.row] = (int32_t)cnt;
-            u8 *dst = L.blk.data() + (size_t)(st.row * Rr * isz);
-            const i64 *src = st.val.data() + j0;
-            if (L.wire == 0)
-                for (i64 c = 0; c < cnt; ++c)
-                    ((int8_t *)dst)[c] = (int8_t)src[c];
-            else if (L.wire == 1)
-                for (i64 c = 0; c < cnt; ++c)
-                    ((int16_t *)dst)[c] = (int16_t)src[c];
-            else if (L.wire == 2)
-                for (i64 c = 0; c < cnt; ++c)
-                    ((int32_t *)dst)[c] = (int32_t)src[c];
-            else
-                std::memcpy(dst, src, (size_t)cnt * 8);
+            copy_narrow(L.blk.data() + ((size_t)(st.row * Rr) << L.wire),
+                        st.val.data() + j0, cnt, L.wire);
+            for (int f = 1; f < n_fields; ++f)
+                copy_narrow(L.xblk[(size_t)(f - 1)].data()
+                                + ((size_t)(st.row * Rr) << L.xwire[f]),
+                            st.xval[(size_t)(f - 1)].data() + j0, cnt,
+                            L.xwire[f]);
             st.launched = st.appended;
             st.pend_any = false;
         }
@@ -455,7 +525,11 @@ struct Core {
     // tail.  Returns rows consumed (0 = chunk head not periodic).
     i64 process_fast(const u8 *base, i64 n, i64 itemsize, i64 o_key,
                      i64 o_id, i64 o_ts, i64 o_marker, i64 o_val) {
-        if (kind != CB || hopping || n < 2) return 0;
+        // single-field only: the bulk path's fused verify+copy is the
+        // bench hot loop and stays specialized; multi-field streams (none
+        // of which are key-periodic in the tracked workloads) take the
+        // general loop
+        if (kind != CB || hopping || n < 2 || n_fields > 1) return 0;
         i64 key0;
         std::memcpy(&key0, base + o_key, 8);
         i64 P = -1;
@@ -571,8 +645,7 @@ struct Core {
                 st.last_pos = nextpos[(size_t)k] - 1;
                 // the block-wide value range over-approximates per key —
                 // safe for wire-dtype choice (never narrower than exact)
-                st.note_val(bmin);
-                st.note_val(bmax);
+                st.note_range0(bmin, bmax);
             }
             for (i64 k = 0; k < P; ++k) {
                 if (mcnt[(size_t)k] == 0) continue;
@@ -602,7 +675,8 @@ struct Core {
     i64 process(const u8 *base, i64 n, i64 itemsize, i64 o_key, i64 o_id,
                 i64 o_ts, i64 o_marker, i64 o_val,
                 i64 shard_mod = 1, i64 shard_id = 0,
-                const u8 *shard_of = nullptr) {
+                const u8 *shard_of = nullptr,
+                const i64 *o_xval = nullptr) {
         const i64 q0 = launches_made;
         if (shard_of == nullptr && shard_mod == 1) {
             const i64 fdone = process_fast(base, n, itemsize, o_key, o_id,
@@ -621,6 +695,10 @@ struct Core {
         // `shard_of` is the precomputed per-row shard-id byte array from
         // wf_cores_process_mt — a 1-byte compare per foreign row instead
         // of a hash + division per row per shard.
+        // a multi-field core driven through the single-field entry points
+        // has no extra offsets: refuse (defined error) instead of
+        // dereferencing null per appended row
+        if (n_fields > 1 && o_xval == nullptr) return -1;
         const u8 sid = (u8)shard_id;
         for (i64 i = 0; i < n; ++i) {
             const u8 *rp = base + i * itemsize;
@@ -650,7 +728,15 @@ struct Core {
                 st.pos.push_back(pos);
                 st.ts.push_back(tsv);
                 st.val.push_back(val);
-                st.note_val(val);
+                i64 vrow[kMaxFields];
+                vrow[0] = val;
+                for (int f = 1; f < n_fields; ++f) {
+                    i64 v;
+                    std::memcpy(&v, rp + o_xval[f - 1], 8);
+                    st.xval[(size_t)(f - 1)].push_back(v);
+                    vrow[f] = v;
+                }
+                st.note_vals(n_fields, vrow);
                 st.appended++;
                 pend_rows++;
             }
@@ -797,6 +883,28 @@ i64 wf_core_process(void *h, const void *base, i64 n, i64 itemsize,
                                 o_ts, o_marker, o_val);
 }
 
+// single source of truth for the staging bound (Python guards read it)
+i64 wf_max_fields(void) { return kMaxFields; }
+
+// Multi-field staging (one device ring per payload column,
+// ops/resident.py MultiFieldResidentExecutor): declare the column count
+// and each field's widest admissible wire dtype.  Contract: call once,
+// right after wf_core_new, before any process call — keys registered
+// earlier would lack the extra archive columns.  Returns the accepted
+// field count; a caller asking for more than kMaxFields MUST treat the
+// short return as a refusal (staging only the prefix would hand the
+// device uninitialized rectangles for the missing columns).
+i64 wf_core_set_fields(void *h, i64 n_fields, const int *max_wires) {
+    Core *c = (Core *)h;
+    int nf = (int)(n_fields < 1 ? 1 : n_fields);
+    if (nf > kMaxFields) nf = kMaxFields;
+    c->n_fields = nf;
+    for (int f = 0; f < nf; ++f)
+        c->max_wire_f[f] = max_wires ? max_wires[f] : c->max_wire;
+    c->max_wire = c->max_wire_f[0];
+    return nf;
+}
+
 // Persistent shard worker pool: threads park on a condvar between chunks
 // instead of being spawned/joined per call (the hot path runs one
 // wf_cores_process_mt per engine batch).  Leaked at process exit on
@@ -866,12 +974,14 @@ ShardPool *shard_pool() {
 // compare — instead of each of the S shards paying a hash + integer
 // division per row (S*n divisions dominated the r1 profile at 56 ns/row).
 // Returns total launches queued.
-i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
-                        i64 itemsize, i64 o_key, i64 o_id, i64 o_ts,
-                        i64 o_marker, i64 o_val) {
+static i64 cores_process_mt_impl(void **hs, i64 n_shards, const void *base,
+                                 i64 n, i64 itemsize, i64 o_key, i64 o_id,
+                                 i64 o_ts, i64 o_marker, i64 o_val,
+                                 const i64 *o_xval) {
     if (n_shards == 1)
         return ((Core *)hs[0])->process((const u8 *)base, n, itemsize,
-                                        o_key, o_id, o_ts, o_marker, o_val);
+                                        o_key, o_id, o_ts, o_marker, o_val,
+                                        1, 0, nullptr, o_xval);
     // shared scratch: both phases must run under one lock so a second
     // engine thread cannot overwrite the byte array between them (leaked
     // at exit on purpose, like the pool)
@@ -899,12 +1009,28 @@ i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
     std::function<void(i64)> fn = [&](i64 t) {
         res[(size_t)t] = ((Core *)hs[t])->process(
             (const u8 *)base, n, itemsize, o_key, o_id, o_ts, o_marker,
-            o_val, n_shards, t, so);
+            o_val, n_shards, t, so, o_xval);
     };
     shard_pool()->run(n_shards, fn);
     i64 total = 0;
     for (i64 t = 0; t < n_shards; ++t) total += res[(size_t)t];
     return total;
+}
+
+i64 wf_cores_process_mt(void **hs, i64 n_shards, const void *base, i64 n,
+                        i64 itemsize, i64 o_key, i64 o_id, i64 o_ts,
+                        i64 o_marker, i64 o_val) {
+    return cores_process_mt_impl(hs, n_shards, base, n, itemsize, o_key,
+                                 o_id, o_ts, o_marker, o_val, nullptr);
+}
+
+// multi-field form: o_vals carries n_fields payload-column offsets
+i64 wf_cores_process_mt_f(void **hs, i64 n_shards, const void *base, i64 n,
+                          i64 itemsize, i64 o_key, i64 o_id, i64 o_ts,
+                          i64 o_marker, const i64 *o_vals) {
+    return cores_process_mt_impl(hs, n_shards, base, n, itemsize, o_key,
+                                 o_id, o_ts, o_marker, o_vals[0],
+                                 o_vals + 1);
 }
 
 i64 wf_core_eos(void *h) { return ((Core *)h)->eos(); }
@@ -1016,7 +1142,8 @@ static inline void wr_elem(u8 *p, int wire, i64 i, i64 v) {
 // verbatim after the merge — so TB and mixed launches coalesce too).
 // Returns false — leaving both untouched — when the pair is incompatible.
 static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
-                      i64 max_mult) {
+                      i64 max_mult, int n_fields) {
+    if (A.xblk.size() != B.xblk.size()) return false;
     // never across a ring rebase, in either role: a rebase launch resets
     // the ring geometry, and the invariant is simplest (and testable) when
     // rebases are dispatch barriers (ADVICE r2: A.rebase was previously
@@ -1115,29 +1242,41 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
                         / bucket(std::max<i64>(A.B, 1));
         if (rr != rb2) return false;
     }
-    const int wire2 = std::max(A.wire, B.wire);
-    const i64 isz2 = 1LL << wire2;
-    std::vector<u8> nblk((size_t)(K2 * newR * isz2), 0);
-    for (i64 k = 0; k < K2; ++k) {
-        const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
-        const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
-        u8 *dst = nblk.data() + (size_t)(k * newR * isz2);
-        if (ra) {
-            const u8 *src = A.blk.data() + (size_t)(k * A.R << A.wire);
-            if (A.wire == wire2)
-                std::memcpy(dst, src, (size_t)(ra * isz2));
-            else
-                for (i64 i = 0; i < ra; ++i)
-                    wr_elem(dst, wire2, i, rd_elem(src, A.wire, i));
-        }
-        if (rb) {
-            const u8 *src = B.blk.data() + (size_t)(k * B.R << B.wire);
-            if (B.wire == wire2)
-                std::memcpy(dst + (size_t)(ra * isz2), src,
-                            (size_t)(rb * isz2));
-            else
-                for (i64 i = 0; i < rb; ++i)
-                    wr_elem(dst, wire2, ra + i, rd_elem(src, B.wire, i));
+    // merge every field's rectangle at that field's widened wire dtype
+    // (field 0 in blk/wire, extras in xblk/xwire — same geometry)
+    std::vector<std::vector<u8>> nblks((size_t)n_fields);
+    int nwires[kMaxFields];
+    for (int f = 0; f < n_fields; ++f) {
+        const std::vector<u8> &Ab = f == 0 ? A.blk : A.xblk[(size_t)(f - 1)];
+        const std::vector<u8> &Bb = f == 0 ? B.blk : B.xblk[(size_t)(f - 1)];
+        const int wa = f == 0 ? A.wire : A.xwire[f];
+        const int wb = f == 0 ? B.wire : B.xwire[f];
+        const int wire2 = std::max(wa, wb);
+        const i64 isz2 = 1LL << wire2;
+        nwires[f] = wire2;
+        std::vector<u8> &nblk = nblks[(size_t)f];
+        nblk.assign((size_t)(K2 * newR * isz2), 0);
+        for (i64 k = 0; k < K2; ++k) {
+            const i64 ra = k < A.K ? A.rows[(size_t)k] : 0;
+            const i64 rb = k < B.K ? B.rows[(size_t)k] : 0;
+            u8 *dst = nblk.data() + (size_t)(k * newR * isz2);
+            if (ra) {
+                const u8 *src = Ab.data() + (size_t)(k * A.R << wa);
+                if (wa == wire2)
+                    std::memcpy(dst, src, (size_t)(ra * isz2));
+                else
+                    for (i64 i = 0; i < ra; ++i)
+                        wr_elem(dst, wire2, i, rd_elem(src, wa, i));
+            }
+            if (rb) {
+                const u8 *src = Bb.data() + (size_t)(k * B.R << wb);
+                if (wb == wire2)
+                    std::memcpy(dst + (size_t)(ra * isz2), src,
+                                (size_t)(rb * isz2));
+                else
+                    for (i64 i = 0; i < rb; ++i)
+                        wr_elem(dst, wire2, ra + i, rd_elem(src, wb, i));
+            }
         }
     }
     // merged per-key state: offsets are A's (B's new keys keep B's),
@@ -1192,14 +1331,17 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     cat64(A.hts, B.hts);
     cat64(A.hlen, B.hlen);
     cat64(A.hpmax, B.hpmax);
-    A.blk = std::move(nblk);
+    A.blk = std::move(nblks[0]);
+    for (int f = 1; f < n_fields; ++f)
+        A.xblk[(size_t)(f - 1)] = std::move(nblks[(size_t)f]);
+    for (int f = 0; f < n_fields; ++f) A.xwire[f] = nwires[f];
     A.offs = std::move(noffs);
     A.rows = std::move(nrows);
     A.rcount = std::move(nrc);
     A.rstart0 = std::move(nrs0);
     A.rlen = std::move(nrl);
     A.cmax = cmax;
-    A.wire = wire2;
+    A.wire = nwires[0];
     A.K = K2;
     A.R = newR;
     A.B = B1 + B2;
@@ -1240,7 +1382,8 @@ i64 wf_launch_coalesce(void *h, i64 max_cells, i64 max_merge,
             B = std::move(c->queue[i + 1]);
             c->queue.erase(c->queue.begin() + i, c->queue.begin() + i + 2);
         }
-        const bool ok = try_merge(A, B, c->slide, max_cells, mcap);
+        const bool ok = try_merge(A, B, c->slide, max_cells, mcap,
+                                  c->n_fields);
         {
             std::lock_guard<std::mutex> lk(c->qmu);
             if (!ok) {
@@ -1297,25 +1440,33 @@ void wf_launch_take_regular(void *h, int32_t *rcount, int32_t *rstart0,
         std::memcpy(widx, L.widx.data(), (size_t)L.B * 4);
 }
 
+// one field's rectangle into the caller's buffer (padded when rows_pad>0)
+static void take_block(Launch &L, int f, void *blk, i64 rows_pad,
+                       i64 cols_pad) {
+    const std::vector<u8> &src_v = f == 0 ? L.blk : L.xblk[(size_t)(f - 1)];
+    const int wire = f == 0 ? L.wire : L.xwire[f];
+    const i64 isz = 1LL << wire;
+    if (rows_pad <= 0) {
+        std::memcpy(blk, src_v.data(), (size_t)(L.K * L.R * isz));
+        return;
+    }
+    // write straight into the caller's (rows_pad, cols_pad) rectangle,
+    // zeroing the padding — saves the ship thread's _pad2 re-copy
+    u8 *dst = (u8 *)blk;
+    const u8 *src = src_v.data();
+    const i64 rowb = L.R * isz, padb = cols_pad * isz;
+    for (i64 r = 0; r < L.K; ++r) {
+        std::memcpy(dst + r * padb, src + r * rowb, (size_t)rowb);
+        std::memset(dst + r * padb + rowb, 0, (size_t)(padb - rowb));
+    }
+    std::memset(dst + L.K * padb, 0, (size_t)((rows_pad - L.K) * padb));
+}
+
 static void take_common(Launch &L, void *blk, i64 rows_pad,
                         i64 cols_pad, i64 *offs, int32_t *wrows,
                         int32_t *wstarts, int32_t *wlens, i64 *hkey,
                         i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax) {
-    const i64 isz = 1LL << L.wire;
-    if (rows_pad <= 0) {
-        std::memcpy(blk, L.blk.data(), (size_t)(L.K * L.R * isz));
-    } else {
-        // write straight into the caller's (rows_pad, cols_pad) rectangle,
-        // zeroing the padding — saves the ship thread's _pad2 re-copy
-        u8 *dst = (u8 *)blk;
-        const u8 *src = L.blk.data();
-        const i64 rowb = L.R * isz, padb = cols_pad * isz;
-        for (i64 r = 0; r < L.K; ++r) {
-            std::memcpy(dst + r * padb, src + r * rowb, (size_t)rowb);
-            std::memset(dst + r * padb + rowb, 0, (size_t)(padb - rowb));
-        }
-        std::memset(dst + L.K * padb, 0, (size_t)((rows_pad - L.K) * padb));
-    }
+    take_block(L, 0, blk, rows_pad, cols_pad);
     std::memcpy(offs, L.offs.data(), (size_t)L.K * 8);
     if (L.B) {
         std::memcpy(wrows, L.wrows.data(), (size_t)L.B * 4);
@@ -1361,6 +1512,34 @@ void wf_launch_take_padded(void *h, void *blk, i64 rows_pad, i64 cols_pad,
     Launch L = pop_front(c);
     take_common(L, blk, rows_pad, cols_pad, offs, wrows, wstarts, wlens,
                 hkey, hid, hts, hlen, hpmax);
+}
+
+// per-field wire dtypes of the front launch (size n_fields; call between
+// peek and take — the consumer allocates one rectangle per field)
+int wf_launch_peek_wires(void *h, int *wires) {
+    Core *c = (Core *)h;
+    std::lock_guard<std::mutex> lk(c->qmu);
+    if (c->queue.empty()) return 0;
+    Launch &L = c->queue.front();
+    wires[0] = L.wire;
+    for (int f = 1; f < c->n_fields; ++f) wires[f] = L.xwire[f];
+    return 1;
+}
+
+// multi-field wf_launch_take_padded: blks carries n_fields destination
+// rectangles (same (rows_pad, cols_pad) geometry, each field's own wire
+// dtype as reported by wf_launch_peek_wires)
+void wf_launch_take_padded_f(void *h, void **blks, i64 rows_pad,
+                             i64 cols_pad, i64 *offs, int32_t *wrows,
+                             int32_t *wstarts, int32_t *wlens, i64 *hkey,
+                             i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax) {
+    Core *c = (Core *)h;
+    const int nf = c->n_fields;
+    Launch L = pop_front(c);
+    take_common(L, blks[0], rows_pad, cols_pad, offs, wrows, wstarts,
+                wlens, hkey, hid, hts, hlen, hpmax);
+    for (int f = 1; f < nf; ++f)
+        take_block(L, f, blks[f], rows_pad, cols_pad);
 }
 
 // ---------------------------------------------------------------- keymap

@@ -720,3 +720,232 @@ def test_proactive_flush_sizing_is_opt_in(monkeypatch):
     finally:
         res._WEATHER.clear()
         res._WEATHER.update(saved)
+
+
+# ------------------------------------------------- multi-field staging (r5)
+
+MF_SCHEMA = Schema(rev=np.int64, amt=np.int64)
+
+
+def mf_stream(n_keys, per_key, chunk=61, seed=0, amt_lo=-40000,
+              amt_hi=40000):
+    """Two int64 payload columns with different value ranges (rev fits
+    int8, amt needs int16/int32) so per-field wire narrowing is live."""
+    rng = np.random.default_rng(seed)
+    batches = []
+    for lo in range(0, per_key, chunk):
+        m = min(chunk, per_key - lo)
+        ids = np.repeat(np.arange(lo, lo + m), n_keys)
+        keys = np.tile(np.arange(n_keys), m)
+        batches.append(batch_from_columns(
+            MF_SCHEMA, key=keys, id=ids, ts=ids,
+            rev=rng.integers(0, 50, size=m * n_keys).astype(np.int64),
+            amt=rng.integers(amt_lo, amt_hi,
+                             size=m * n_keys).astype(np.int64)))
+    return batches
+
+
+def mf_agg():
+    from windflow_tpu.ops.functions import MultiReducer
+    return MultiReducer(("count", None, "n"), ("max", "id", "hi"),
+                        ("sum", "rev", "rsum"), ("min", "amt", "alo"),
+                        ("max", "amt", "ahi"))
+
+
+def assert_mf_equal(host, got, fields=("key", "id", "ts", "n", "hi",
+                                       "rsum", "alo", "ahi")):
+    assert len(host) == len(got)
+    for f in fields:
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+
+
+@pytest.mark.parametrize("win,slide,wt", [
+    (16, 4, WinType.CB), (24, 24, WinType.CB), (50, 25, WinType.TB)])
+def test_native_multifield_matches_host(win, slide, wt):
+    """r5: a MultiReducer with >1 device-worthy stat over 2 fields stages
+    per-field columns through the C++ core (wf_core_set_fields /
+    wf_cores_process_mt_f) into per-field device rings and matches the
+    host core field-for-field — counts and MAX(position) still answered
+    host-side, the two payload columns narrowed independently."""
+    spec = WindowSpec(win, slide, wt)
+    if wt is WinType.TB:
+        rng = np.random.default_rng(5)
+        nk, per = 3, 420
+        batches = []
+        for lo in range(0, per, 71):
+            m = min(71, per - lo)
+            batches.append(batch_from_columns(
+                MF_SCHEMA, key=np.tile(np.arange(nk), m),
+                id=np.repeat(np.arange(lo, lo + m), nk),
+                ts=np.repeat(np.arange(lo, lo + m) * 7, nk),
+                rev=rng.integers(0, 50, size=m * nk).astype(np.int64),
+                amt=rng.integers(-9000, 9000,
+                                 size=m * nk).astype(np.int64)))
+    else:
+        batches = mf_stream(4, 700, seed=win + slide)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, mf_agg(), batch_len=64, flush_rows=150)
+    assert isinstance(core, NativeResidentCore)
+    # CB: max(id) is the position stat (host-free) -> 2 staged fields;
+    # TB: position is ts, so id ships as a THIRD staged field
+    want_fields = (("rev", "amt") if wt is WinType.CB
+                   else ("id", "rev", "amt"))
+    assert core._multi and core._ship_fields == want_fields
+    host = run_core(WinSeqCore(spec, mf_agg()), batches)
+    assert_mf_equal(host, run_core(core, batches))
+
+
+def test_native_multifield_single_field_multi_op():
+    """Two ops over ONE field also take the native multi path (one ring,
+    two stat evaluations per dispatch)."""
+    from windflow_tpu.ops.functions import MultiReducer
+    agg = MultiReducer(("sum", "value", "sm"), ("max", "value", "mx"))
+    spec = WindowSpec(16, 4, WinType.CB)
+    batches = cb_stream(5, 600, seed=11)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, agg, batch_len=64, flush_rows=150)
+    assert isinstance(core, NativeResidentCore)
+    assert core._multi and core._ship_fields == ("value",)
+    host = run_core(WinSeqCore(spec, MultiReducer(
+        ("sum", "value", "sm"), ("max", "value", "mx"))), batches)
+    assert len(host) == len(core_out := run_core(core, batches))
+    for f in ("key", "id", "ts", "sm", "mx"):
+        np.testing.assert_array_equal(host[f], core_out[f], err_msg=f)
+
+
+def test_native_multifield_per_field_wire_narrowing():
+    """The C ABI narrows each staged column independently: rev in [0,50)
+    ships int8 while amt spans int16 — asserted straight off
+    wf_launch_peek_wires on a hand-driven core."""
+    import ctypes
+
+    from windflow_tpu import native as nat
+    lib = nat.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    h = lib.wf_core_new(8, 8, 0, 0, 0, 1, 8, 0, 1, 8, 0, 1, 8,
+                        1 << 20, 64, 2)
+    try:
+        mw = (ctypes.c_int * 2)(2, 2)
+        lib.wf_core_set_fields(h, 2, mw)
+        b = batch_from_columns(
+            MF_SCHEMA, key=np.zeros(128, dtype=np.int64),
+            id=np.arange(128), ts=np.arange(128),
+            rev=np.full(128, 7, dtype=np.int64),
+            amt=np.full(128, 30000, dtype=np.int64))
+        f = b.dtype.fields
+        voffs = np.array([f["rev"][1], f["amt"][1]], dtype=np.int64)
+        harr = (ctypes.c_void_p * 1)(h)
+        lib.wf_cores_process_mt_f(
+            harr, 1, b.ctypes.data, len(b), b.dtype.itemsize,
+            f["key"][1], f["id"][1], f["ts"][1], f["marker"][1],
+            voffs.ctypes.data_as(nat.p_i64))
+        assert lib.wf_launch_pending(h) >= 1
+        wires = (ctypes.c_int * 2)()
+        assert lib.wf_launch_peek_wires(h, wires) == 1
+        assert list(wires) == [0, 1], "rev int8 wire, amt int16 wire"
+    finally:
+        lib.wf_core_free(h)
+
+
+def test_native_multifield_coalescing_matches_host():
+    """Queued multi-field launches merge per field (each at its own
+    widened wire dtype) and stay exact: tiny flush_rows force a deep
+    queue, chunks alternate narrow/wide amt ranges so the merged columns
+    must widen."""
+    spec = WindowSpec(16, 4, WinType.CB)
+    rng = np.random.default_rng(13)
+    batches = []
+    for c, (lo, hi) in enumerate([(-5, 5), (-30000, 30000)] * 3):
+        m = 300
+        ids = np.repeat(np.arange(c * m, (c + 1) * m), 3)
+        keys = np.tile(np.arange(3), m)
+        batches.append(batch_from_columns(
+            MF_SCHEMA, key=keys, id=ids, ts=ids,
+            rev=rng.integers(0, 40, size=m * 3).astype(np.int64),
+            amt=rng.integers(lo, hi, size=m * 3).astype(np.int64)))
+    host = run_core(WinSeqCore(spec, mf_agg()), batches)
+    nat = make_native(spec, mf_agg(), batch_len=1 << 20, flush_rows=96,
+                      overlap=False)
+    assert nat._multi
+    merges = []
+    real = nat._lib
+
+    class _Shim:
+        def __getattr__(self, name):
+            if name != "wf_launch_coalesce":
+                return getattr(real, name)
+
+            def counting(h, cells, mx, mult):
+                n = real.wf_launch_coalesce(h, cells, mx, mult)
+                merges.append(n)
+                return n
+            return counting
+
+    nat._lib = _Shim()
+    assert_mf_equal(host, run_core(nat, batches))
+    assert sum(merges) > 0, "multi-field launches never merged"
+
+
+def test_native_multifield_sharded_and_overlap():
+    """Key-sharded MT (wf_cores_process_mt_f two-phase pool) + ship
+    threads compose with multi-field staging."""
+    spec = WindowSpec(12, 3, WinType.CB)
+    batches = mf_stream(7, 500, chunk=83, seed=29)
+    host = run_core(WinSeqCore(spec, mf_agg()), batches)
+    nat = make_native(spec, mf_agg(), batch_len=64, flush_rows=120,
+                      shards=2, overlap=True)
+    assert nat._multi and nat.shards == 2
+    assert_mf_equal(host, run_core(nat, batches))
+
+
+def test_native_multifield_float_routes_python():
+    """Float stats keep the Python resident core (the native ABI ships
+    int64 columns); >4 distinct fields too."""
+    from windflow_tpu.ops.functions import MultiReducer, Reducer as R
+    from windflow_tpu.patterns.win_seq_tpu import ResidentWinSeqCore
+    spec = WindowSpec(16, 4, WinType.CB)
+    agg = MultiReducer(R("sum", "rev", "rs"),
+                       R("min", "amt", "al", dtype=np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, agg, batch_len=64, flush_rows=150)
+    assert isinstance(core, ResidentWinSeqCore)
+    agg5 = MultiReducer(*[("max", f"f{i}", f"o{i}") for i in range(5)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core5 = make_core_for(spec, agg5, batch_len=64, flush_rows=150)
+    assert isinstance(core5, ResidentWinSeqCore)
+
+
+def test_native_multifield_falls_back_on_nonint_column():
+    """A non-int64 batch column (int32 here) under a staged stat falls
+    back to the Python core transparently mid-stream — the native ABI
+    ships int64 columns and its schema check is at runtime."""
+    schema = Schema(rev=np.int64, amt=np.int32)
+    rng = np.random.default_rng(31)
+    m, nk = 400, 3
+    b = batch_from_columns(
+        schema, key=np.tile(np.arange(nk), m),
+        id=np.repeat(np.arange(m), nk),
+        ts=np.repeat(np.arange(m), nk),
+        rev=rng.integers(0, 50, size=m * nk).astype(np.int64),
+        amt=rng.integers(-9000, 9000, size=m * nk).astype(np.int32))
+    from windflow_tpu.ops.functions import MultiReducer
+    agg = MultiReducer(("sum", "rev", "rs"), ("max", "amt", "ah"),
+                       dtype=np.int64)
+    spec = WindowSpec(16, 4, WinType.CB)
+    nat = make_native(spec, agg, batch_len=64, flush_rows=150)
+    assert nat._multi
+    out = nat.process(b)
+    tail = nat.flush()
+    assert nat._delegate is not None, "expected fallback to Python core"
+    got = np.sort(np.concatenate([o for o in (out, tail) if len(o)]),
+                  order=["key", "id"])
+    host = run_core(WinSeqCore(spec, MultiReducer(
+        ("sum", "rev", "rs"), ("max", "amt", "ah"), dtype=np.int64)), [b])
+    assert len(host) == len(got)
+    for f in ("key", "id", "rs"):
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)

@@ -99,6 +99,20 @@ def _bind(lib):
     lib.wf_cores_process_mt.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,
         i64, i64, i64, i64, i64, i64, i64]
+    lib.wf_max_fields.restype = i64
+    lib.wf_max_fields.argtypes = []
+    lib.wf_core_set_fields.restype = i64
+    lib.wf_core_set_fields.argtypes = [ctypes.c_void_p, i64, p_int]
+    lib.wf_cores_process_mt_f.restype = i64
+    lib.wf_cores_process_mt_f.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), i64, ctypes.c_void_p,
+        i64, i64, i64, i64, i64, i64, p_i64]
+    lib.wf_launch_peek_wires.restype = ctypes.c_int
+    lib.wf_launch_peek_wires.argtypes = [ctypes.c_void_p, p_int]
+    lib.wf_launch_take_padded_f.restype = None
+    lib.wf_launch_take_padded_f.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), i64, i64,
+        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64, p_i64]
     lib.wf_launch_pending.restype = i64
     lib.wf_launch_pending.argtypes = [ctypes.c_void_p]
     lib.wf_launch_peek.restype = ctypes.c_int

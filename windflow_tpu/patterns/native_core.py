@@ -89,27 +89,48 @@ class NativeResidentCore:
             raise RuntimeError("native library unavailable")
         from ..ops.functions import MultiReducer
         if isinstance(reducer, MultiReducer):
-            # multi-stat with exactly ONE device-worthy stat: counts come
-            # from window lengths and MAX over the position field from the
-            # C++ archive's per-window last row (hpmax) — e.g. YSB's
-            # COUNT + MAX(ts) + SUM(revenue) ships only revenue while the
-            # whole hot loop stays in C++
+            # counts come from window lengths and MAX over the position
+            # field from the C++ archive's per-window last row (hpmax) —
+            # e.g. YSB's COUNT + MAX(ts) + SUM(revenue) ships only revenue
+            # while the whole hot loop stays in C++.  Remaining
+            # device-worthy stats stage one int64 column per distinct
+            # field (C++ kMaxFields = 4) into per-field device rings
+            # (MultiFieldResidentExecutor) — the rich-aggregate form that
+            # previously re-paid the Python hot loop (BASELINE.md round 5:
+            # --rich-stats ingested 5.4M vs the native base's 10.8M).
             from .win_seq_tpu import split_pos_max
             dev, pos = split_pos_max(spec, reducer)
-            if len(dev) != 1:
+            if not dev:
                 raise TypeError(
-                    "native resident core needs exactly one device-worthy "
-                    f"stat (got {len(dev)} after the pos-max split)")
-            self._dev_part = dev[0]
+                    "native resident core needs >=1 device-worthy stat "
+                    "after the pos-max split")
+            self._dev_parts = dev
             self._pos_max_parts = pos
             self._count_parts = reducer.count_parts
         elif isinstance(reducer, Reducer):
-            self._dev_part = reducer
+            self._dev_parts = [reducer]
             self._pos_max_parts = []
             self._count_parts = []
         else:
             raise TypeError("native resident core needs a builtin "
                             "(Multi)Reducer")
+        self._dev_part = self._dev_parts[0]
+        self._ship_fields = tuple(dict.fromkeys(
+            p.field for p in self._dev_parts))
+        #: >1 device stat (several fields, or several ops over one field):
+        #: per-field rings via MultiFieldResidentExecutor; the single-stat
+        #: path keeps its regular-descriptor compression
+        self._multi = len(self._dev_parts) > 1
+        max_fields = int(self._lib.wf_max_fields())
+        if len(self._ship_fields) > max_fields:
+            raise TypeError(
+                f"native resident core stages at most {max_fields} "
+                f"payload columns (got fields {self._ship_fields})")
+        if self._multi and any(np.issubdtype(p.dtype, np.floating)
+                               for p in self._dev_parts):
+            raise TypeError(
+                "native multi-field staging ships int64 columns; float "
+                "stats run on the Python resident core")
         self.spec = spec
         self.reducer = reducer
         self.field = self._dev_part.field
@@ -133,6 +154,18 @@ class NativeResidentCore:
         self._last_flush_t = None
         from .win_seq_tpu import resolve_worker_device, select_acc_dtype
         acc = select_acc_dtype(self._dev_part, compute_dtype, spec)
+        #: per-field ring dtypes for the multi path (same rules as
+        #: ResidentWinSeqCore: widest acc per field, consistent kind)
+        self._acc_by_field = {}
+        for p in self._dev_parts:
+            a = select_acc_dtype(p, compute_dtype, spec)
+            prev = self._acc_by_field.get(p.field)
+            if prev is not None and prev.kind != a.kind:
+                raise ValueError(
+                    f"stats over field {p.field!r} disagree on "
+                    f"accumulate kind ({prev} vs {a})")
+            if prev is None or a.itemsize > prev.itemsize:
+                self._acc_by_field[p.field] = a
         # key-sharded multithreading: shard t owns keys with
         # mix64(key) %% S == t (a hash decorrelated from the farm routing
         # modulus — see wf_native.cpp), each with an independent sub-core,
@@ -145,7 +178,23 @@ class NativeResidentCore:
         # *byte* array (wf_native.cpp:wf_cores_process_mt), so ids beyond
         # u8 would alias and double-process rows
         self.shards = max(min(int(shards), 256), 1)
-        if mesh is not None:
+        if self._multi:
+            if mesh is not None:
+                raise TypeError(
+                    "native multi-field staging has no mesh form yet; "
+                    "mesh multi-stat runs on the Python resident core "
+                    "(MeshMultiFieldResidentExecutor)")
+            from ..ops.resident import MultiFieldResidentExecutor
+            self.executors = [
+                MultiFieldResidentExecutor(
+                    self._ship_fields,
+                    stats=tuple((p.op, p.field) for p in self._dev_parts),
+                    acc_dtypes=self._acc_by_field,
+                    device=resolve_worker_device(
+                        device, worker_index * self.shards + t),
+                    depth=depth)
+                for t in range(self.shards)]
+        elif mesh is not None:
             # mesh execution composes with host key-sharding: shard t's
             # sub-core keeps its own C++ bookkeeping AND its own
             # mesh-sharded ring (each P(kf, None) over every chip), so a
@@ -175,6 +224,21 @@ class NativeResidentCore:
             int(map_indexes[0]), int(map_indexes[1]),
             int(self.result_ts_slide), int(batch_len), int(flush_rows),
             3 if acc.itemsize >= 8 else 2) for _ in range(self.shards)]
+        if self._multi:
+            # per-field widest wire dtype (ship_fields order): the C++
+            # flush narrows each column independently against its ring
+            mw = (ctypes.c_int * len(self._ship_fields))(*[
+                3 if self._acc_by_field[f].itemsize >= 8 else 2
+                for f in self._ship_fields])
+            for h in self._hs:
+                got = self._lib.wf_core_set_fields(
+                    h, len(self._ship_fields), mw)
+                if got != len(self._ship_fields):
+                    # a short accept would leave the missing columns'
+                    # rectangles uninitialized at take time — refuse
+                    raise TypeError(
+                        f"native core accepted {got} fields, "
+                        f"need {len(self._ship_fields)}")
         self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
         # proactive dispatch sizing: seed the natural flush size from the
         # process-global wire weather (a warmup run's harvests populate
@@ -235,7 +299,7 @@ class NativeResidentCore:
         #: flush_rows) from blowing host memory; must admit a full
         #: ladder-deep merge of benchmark-shaped launches (16x of a
         #: 2^19-row flush = 2^23 cells)
-        self._coalesce_cells = 1 << 24
+        self._coalesce_cells = (1 << 24) // max(len(self._ship_fields), 1)
         if self._overlap:
             self._out_q = _queue.SimpleQueue()
             # one ship thread per shard: each owns its executor, so the
@@ -319,12 +383,16 @@ class NativeResidentCore:
     def _field_offsets(self, batch):
         if self._offsets is None:
             f = batch.dtype.fields
-            if (self.field not in f or f[self.field][0] != np.int64
+            if (any(fl not in f or f[fl][0] != np.int64
+                    for fl in self._ship_fields)
                     or batch.dtype[MARKER_FIELD] != np.bool_):
                 return None
             self._offsets = (batch.dtype.itemsize, f["key"][1], f["id"][1],
                              f["ts"][1], f[MARKER_FIELD][1],
-                             f[self.field][1])
+                             f[self._ship_fields[0]][1])
+            #: payload-column offsets, ship_fields order (the _f ABI)
+            self._voffs = np.array([f[fl][1] for fl in self._ship_fields],
+                                   dtype=np.int64)
         return self._offsets
 
     # ------------------------------------------------------------ streaming
@@ -347,9 +415,16 @@ class NativeResidentCore:
         if b is not None:
             itemsize, o_key, o_id, o_ts, o_mk, o_val = self._offsets
             with profile.span("native_bookkeeping"):
-                launched = self._lib.wf_cores_process_mt(
-                    self._harr, self.shards, b.ctypes.data, len(b), itemsize,
-                    o_key, o_id, o_ts, o_mk, o_val)
+                if self._multi:
+                    from ..native import p_i64
+                    launched = self._lib.wf_cores_process_mt_f(
+                        self._harr, self.shards, b.ctypes.data, len(b),
+                        itemsize, o_key, o_id, o_ts, o_mk,
+                        self._voffs.ctypes.data_as(p_i64))
+                else:
+                    launched = self._lib.wf_cores_process_mt(
+                        self._harr, self.shards, b.ctypes.data, len(b),
+                        itemsize, o_key, o_id, o_ts, o_mk, o_val)
         if self.max_delay_s is not None:
             now = time.monotonic()
             if self._last_flush_t is None or launched:
@@ -510,11 +585,20 @@ class NativeResidentCore:
                                   ctypes.byref(cap)):
             return False
         K, R, B = K.value, R.value, B.value
-        # allocate the device-ready zero-padded rectangle and let the C++
-        # take fill it directly (no _pad2 re-copy on this thread)
+        # allocate the device-ready zero-padded rectangle(s) and let the
+        # C++ take fill them directly (no _pad2 re-copy on this thread)
         from ..ops.device import _bucket
         KPp, Rb = KP.value, _bucket(max(R, 1))
-        blk = np.empty((KPp, Rb), dtype=_WIRE_DTYPES[wire.value])
+        blks = blk = None
+        if self._multi:
+            # one rectangle per ship field, each in the per-field wire
+            # dtype the C++ flush narrowed that column to
+            wires = (ctypes.c_int * len(self._ship_fields))()
+            lib.wf_launch_peek_wires(handle, wires)
+            blks = {f: np.empty((KPp, Rb), dtype=_WIRE_DTYPES[wires[i]])
+                    for i, f in enumerate(self._ship_fields)}
+        else:
+            blk = np.empty((KPp, Rb), dtype=_WIRE_DTYPES[wire.value])
         offs = np.empty(K, dtype=np.int64)
         wrows = np.empty(max(B, 1), dtype=np.int32)
         hkey = np.empty(max(B, 1), dtype=np.int64)
@@ -527,7 +611,7 @@ class NativeResidentCore:
         p64 = ctypes.POINTER(ctypes.c_longlong)
         regular = False
         cmax = ctypes.c_longlong()
-        if (self._dev_part.op == "sum"
+        if (not self._multi and self._dev_part.op == "sum"
                 and lib.wf_launch_peek_regular(handle, ctypes.byref(cmax))):
             regular = True
             rcount = np.empty(K, dtype=np.int32)
@@ -547,22 +631,35 @@ class NativeResidentCore:
             wstarts_p = wstarts.ctypes.data_as(p32)
             wlens_p = wlens.ctypes.data_as(p32)
         with profile.span("launch_take"):
-            lib.wf_launch_take_padded(
-                handle, blk.ctypes.data_as(ctypes.c_void_p), KPp, Rb,
-                offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
-                wstarts_p, wlens_p,
-                hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
-                hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64),
-                hpm.ctypes.data_as(p64) if hpm is not None else None)
+            if self._multi:
+                ptrs = (ctypes.c_void_p * len(self._ship_fields))(
+                    *[b.ctypes.data for b in blks.values()])
+                lib.wf_launch_take_padded_f(
+                    handle, ptrs, KPp, Rb,
+                    offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
+                    wstarts_p, wlens_p,
+                    hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
+                    hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64),
+                    hpm.ctypes.data_as(p64) if hpm is not None else None)
+            else:
+                lib.wf_launch_take_padded(
+                    handle, blk.ctypes.data_as(ctypes.c_void_p), KPp, Rb,
+                    offs.ctypes.data_as(p64), wrows.ctypes.data_as(p32),
+                    wstarts_p, wlens_p,
+                    hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
+                    hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64),
+                    hpm.ctypes.data_as(p64) if hpm is not None else None)
         if rebase.value:
             ex.reset(max(K, 1), cap.value)
-        if getattr(ex, "mesh", None) is not None:
+        if blk is not None and getattr(ex, "mesh", None) is not None:
             # the mesh executor re-scatters rows onto its own (shard-
             # rounded) KP; hand it the live rows only, not the C++ padding
             blk = blk[:K]
         meta = (hkey[:B], hid[:B], hts[:B], hlen[:B],
                 hpm[:B] if hpm is not None else None)
-        if regular:
+        if self._multi:
+            ex.launch(meta, blks, offs, wrows[:B], wstarts[:B], wlens[:B])
+        elif regular:
             # per-key arithmetic descriptors instead of 3x B int32 arrays
             ex.launch_regular(meta, blk, offs, rcount, rstart0, rlen,
                               self.spec.slide_len, wrows[:B], widx[:B],
@@ -577,12 +674,15 @@ class NativeResidentCore:
         from .win_seq_tpu import finalize_window_values
         outs = []
         for (hkey, hid, hts, hlen, hpm), out in harvested:
-            res = np.zeros(len(out), dtype=self._result_dtype)
+            # multi executors return one array per stat (dev_parts
+            # order); the single path returns the stat array itself
+            arrs = out if isinstance(out, tuple) else (out,)
+            res = np.zeros(len(arrs[0]), dtype=self._result_dtype)
             res["key"] = hkey
             res["id"] = hid
             res["ts"] = hts
-            res[self.out_field] = finalize_window_values(self._dev_part,
-                                                         out, hlen)
+            for part, a in zip(self._dev_parts, arrs):
+                res[part.out_field] = finalize_window_values(part, a, hlen)
             for part in self._count_parts:
                 res[part.out_field] = hlen.astype(part.dtype)
             for part in self._pos_max_parts:

@@ -805,10 +805,21 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                 f"{_RESIDENT_OPS}, no float sum (got {winfunc.parts})")
         dev_parts, _pos = split_pos_max(spec, winfunc)
         from ..native import enabled
-        if mesh is None and len(dev_parts) == 1 and enabled() is not None:
-            # exactly one stat needs the device after the pos-max split
-            # (counts and max-over-position are answered host-side): the
-            # C++ core carries the whole hot loop and ships one column
+        _nat = enabled()
+        if (mesh is None and _nat is not None
+                and (len(dev_parts) == 1
+                     or (len({p.field for p in dev_parts})
+                         <= int(_nat.wf_max_fields())
+                         and not any(np.issubdtype(p.dtype, np.floating)
+                                     for p in dev_parts)))):
+            # the C++ core carries the whole hot loop: counts and
+            # max-over-position are answered host-side (window lengths /
+            # the archive's per-window last row), and the remaining
+            # device-worthy stats stage one narrowed int64 column per
+            # distinct field — up to the C++ kMaxFields=4 — into
+            # per-field device rings (rich multi-field aggregates
+            # previously re-paid the Python hot loop; float stats still
+            # do, by the Python core's design)
             from .native_core import NativeResidentCore
             return NativeResidentCore(
                 spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
