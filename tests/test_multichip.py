@@ -257,6 +257,14 @@ def test_mesh_multifield_matches_host():
         warnings.simplefilter("ignore")
         core = make_core_for(spec, mf, mesh=mesh, batch_len=16)
         assert isinstance(core.executor, MeshMultiFieldResidentExecutor)
+        # r5: the pod shape keeps the C++ hot loop for rich aggregates
+        # too — mesh multi-field rides NativeResidentCore when the
+        # native library is available (Python core otherwise)
+        from windflow_tpu.native import enabled
+        if enabled() is not None:
+            from windflow_tpu.patterns.native_core import \
+                NativeResidentCore
+            assert isinstance(core, NativeResidentCore) and core._multi
         got = run_core(core)
     want = run_core(WinSeqCore(spec, mf))
     assert len(got) == len(want)

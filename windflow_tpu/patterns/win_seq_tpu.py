@@ -806,7 +806,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
         dev_parts, _pos = split_pos_max(spec, winfunc)
         from ..native import enabled
         _nat = enabled()
-        if (mesh is None and _nat is not None
+        if (_nat is not None
                 and (len(dev_parts) == 1
                      or (len({p.field for p in dev_parts})
                          <= int(_nat.wf_max_fields())
@@ -819,7 +819,10 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
             # distinct field — up to the C++ kMaxFields=4 — into
             # per-field device rings (rich multi-field aggregates
             # previously re-paid the Python hot loop; float stats still
-            # do, by the Python core's design)
+            # do, by the Python core's design).  With a mesh the rings
+            # shard P(kf, None) (Mesh[MultiField]ResidentExecutor) — the
+            # pod shape keeps the C++ bookkeeping for every aggregate
+            # form
             from .native_core import NativeResidentCore
             return NativeResidentCore(
                 spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
@@ -827,7 +830,8 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                 result_ts_slide=result_ts_slide, device=device,
                 depth=depth if depth is not None else 8,
                 compute_dtype=compute_dtype, shards=shards,
-                worker_index=worker_index, max_delay_ms=max_delay_ms)
+                worker_index=worker_index, max_delay_ms=max_delay_ms,
+                mesh=mesh)
         return ResidentWinSeqCore(
             spec, winfunc, batch_len=batch_len, flush_rows=flush_rows,
             config=config, role=role, map_indexes=map_indexes,
