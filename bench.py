@@ -313,7 +313,7 @@ def main():
                         w32.ctypes.data_as(p32), s32.ctypes.data_as(p32),
                         l32.ctypes.data_as(p32), h64.ctypes.data_as(p64),
                         h64.ctypes.data_as(p64), h64.ctypes.data_as(p64),
-                        h64.ctypes.data_as(p64), None)
+                        h64.ctypes.data_as(p64), None, None)
 
             try:
                 t0 = time.perf_counter()

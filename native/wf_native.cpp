@@ -182,6 +182,9 @@ struct Launch {
                               // ordered archive: the window's last row) —
                               // host-side MAX(ts)/MAX(id) for multi-stat
                               // aggregates without shipping the column
+    std::vector<i64> hpmin;   // B per-window MIN position: the window's
+                              // FIRST row, free by the same ordering —
+                              // first-update style stats never ship
 };
 
 struct Core {
@@ -205,7 +208,7 @@ struct Core {
 
     // pending fired windows (absolute row coords; ring coords at flush)
     std::vector<int32_t> wrow;
-    std::vector<i64> wlo, wlen, hkey, hid, hts, hpm;
+    std::vector<i64> wlo, wlen, hkey, hid, hts, hpm, hpmn;
     i64 pend_rows = 0;
 
     i64 KP = 0, cap = 0;              // current ring geometry
@@ -306,6 +309,7 @@ struct Core {
             hid.push_back(rid);
             hts.push_back(out_ts);
             hpm.push_back(hi > lo ? p[hi - 1] : 0);
+            hpmn.push_back(hi > lo ? p[lo] : 0);
             if (!eos) st.purge_pos = std::max(st.purge_pos, s_abs);
         }
     }
@@ -500,6 +504,7 @@ struct Core {
         L.hid = std::move(hid);
         L.hts = std::move(hts);
         L.hpmax = std::move(hpm);
+        L.hpmin = std::move(hpmn);
         L.K = K; L.R = Rr; L.B = B; L.KP = KP; L.cap = cap;
         L.rebase = rebase ? 1 : 0;
         {
@@ -510,7 +515,7 @@ struct Core {
         for (auto &st : keys) st.purge();
         pend_rows = 0;
         wrow.clear(); wlo.clear(); wlen.clear();
-        hkey = {}; hid = {}; hts = {}; hpm = {};
+        hkey = {}; hid = {}; hts = {}; hpm = {}; hpmn = {};
     }
 
     // Bulk path for key-PERIODIC in-order chunks — the shape every
@@ -1331,6 +1336,7 @@ static bool try_merge(Launch &A, Launch &B, i64 slide, i64 max_cells,
     cat64(A.hts, B.hts);
     cat64(A.hlen, B.hlen);
     cat64(A.hpmax, B.hpmax);
+    cat64(A.hpmin, B.hpmin);
     A.blk = std::move(nblks[0]);
     for (int f = 1; f < n_fields; ++f)
         A.xblk[(size_t)(f - 1)] = std::move(nblks[(size_t)f]);
@@ -1465,7 +1471,8 @@ static void take_block(Launch &L, int f, void *blk, i64 rows_pad,
 static void take_common(Launch &L, void *blk, i64 rows_pad,
                         i64 cols_pad, i64 *offs, int32_t *wrows,
                         int32_t *wstarts, int32_t *wlens, i64 *hkey,
-                        i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax) {
+                        i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax,
+                        i64 *hpmin) {
     take_block(L, 0, blk, rows_pad, cols_pad);
     std::memcpy(offs, L.offs.data(), (size_t)L.K * 8);
     if (L.B) {
@@ -1478,8 +1485,9 @@ static void take_common(Launch &L, void *blk, i64 rows_pad,
         std::memcpy(hid, L.hid.data(), (size_t)L.B * 8);
         std::memcpy(hts, L.hts.data(), (size_t)L.B * 8);
         std::memcpy(hlen, L.hlen.data(), (size_t)L.B * 8);
-        // callers with no host-side position-max stats pass null
+        // callers with no host-side position-extremum stats pass null
         if (hpmax) std::memcpy(hpmax, L.hpmax.data(), (size_t)L.B * 8);
+        if (hpmin) std::memcpy(hpmin, L.hpmin.data(), (size_t)L.B * 8);
     }
 }
 
@@ -1498,7 +1506,7 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
     Core *c = (Core *)h;
     Launch L = pop_front(c);
     take_common(L, blk, 0, 0, offs, wrows, wstarts, wlens,
-                hkey, hid, hts, hlen, nullptr);
+                hkey, hid, hts, hlen, nullptr, nullptr);
 }
 
 // wf_launch_take writing blk into a zero-padded (rows_pad, cols_pad)
@@ -1507,11 +1515,11 @@ void wf_launch_take(void *h, void *blk, i64 *offs, int32_t *wrows,
 void wf_launch_take_padded(void *h, void *blk, i64 rows_pad, i64 cols_pad,
                            i64 *offs, int32_t *wrows, int32_t *wstarts,
                            int32_t *wlens, i64 *hkey, i64 *hid, i64 *hts,
-                           i64 *hlen, i64 *hpmax) {
+                           i64 *hlen, i64 *hpmax, i64 *hpmin) {
     Core *c = (Core *)h;
     Launch L = pop_front(c);
     take_common(L, blk, rows_pad, cols_pad, offs, wrows, wstarts, wlens,
-                hkey, hid, hts, hlen, hpmax);
+                hkey, hid, hts, hlen, hpmax, hpmin);
 }
 
 // per-field wire dtypes of the front launch (size n_fields; call between
@@ -1532,12 +1540,13 @@ int wf_launch_peek_wires(void *h, int *wires) {
 void wf_launch_take_padded_f(void *h, void **blks, i64 rows_pad,
                              i64 cols_pad, i64 *offs, int32_t *wrows,
                              int32_t *wstarts, int32_t *wlens, i64 *hkey,
-                             i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax) {
+                             i64 *hid, i64 *hts, i64 *hlen, i64 *hpmax,
+                             i64 *hpmin) {
     Core *c = (Core *)h;
     const int nf = c->n_fields;
     Launch L = pop_front(c);
     take_common(L, blks[0], rows_pad, cols_pad, offs, wrows, wstarts,
-                wlens, hkey, hid, hts, hlen, hpmax);
+                wlens, hkey, hid, hts, hlen, hpmax, hpmin);
     for (int f = 1; f < nf; ++f)
         take_block(L, f, blks[f], rows_pad, cols_pad);
 }

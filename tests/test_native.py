@@ -949,3 +949,87 @@ def test_native_multifield_falls_back_on_nonint_column():
     assert len(host) == len(got)
     for f in ("key", "id", "rs"):
         np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+
+
+def test_native_pos_min_split_matches_host():
+    """r5: MIN over the position field rides the pos-extrema split — the
+    window's FIRST archived row, no column shipped — alongside MAX, on
+    both TB (pos=ts) and CB (pos=id) windows, native vs host exact."""
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.patterns.win_seq_tpu import split_pos_max
+
+    # TB: first/lastUpdate style aggregate; device half = sum(value) only
+    spec = WindowSpec(50, 25, WinType.TB)
+    agg = MultiReducer(("count", None, "n"), ("min", "ts", "first"),
+                       ("max", "ts", "last"), ("sum", "value", "sm"))
+    dev, pos = split_pos_max(spec, agg)
+    assert [p.field for p in dev] == ["value"]
+    assert sorted(p.op for p in pos) == ["max", "min"]
+    rng = np.random.default_rng(41)
+    nk, per = 3, 400
+    batches = []
+    for lo in range(0, per, 67):
+        m = min(67, per - lo)
+        batches.append(batch_from_columns(
+            SCHEMA, key=np.tile(np.arange(nk), m),
+            id=np.repeat(np.arange(lo, lo + m), nk),
+            ts=np.repeat(np.arange(lo, lo + m) * 7 + 3, nk),
+            value=rng.integers(-50, 100, size=m * nk).astype(np.int64)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, agg, batch_len=32, flush_rows=150)
+    assert isinstance(core, NativeResidentCore)
+    host = run_core(WinSeqCore(spec, agg), batches)
+    got = run_core(core, batches)
+    assert len(host) == len(got)
+    for f in ("key", "id", "ts", "n", "first", "last", "sm"):
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+
+    # CB sliding (regular-descriptor launches must carry hpmin too) —
+    # and an ENTIRELY host-free aggregate routes to the host core
+    from windflow_tpu.core.winseq import WinSeqCore as HostCore
+    spec = WindowSpec(16, 4, WinType.CB)
+    cb = MultiReducer(("min", "id", "lo"), ("max", "id", "hi"),
+                      ("sum", "value", "sm"))
+    batches = cb_stream(4, 700, chunk=128, seed=47)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, cb, batch_len=1 << 20, flush_rows=200)
+    assert isinstance(core, NativeResidentCore)
+    host = run_core(HostCore(spec, cb), batches)
+    got = run_core(core, batches)
+    for f in ("key", "id", "lo", "hi", "sm"):
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+    free = MultiReducer(("count", None, "n"), ("min", "id", "lo"),
+                        ("max", "id", "hi"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        hostish = make_core_for(spec, free, batch_len=64)
+    assert not isinstance(hostish, NativeResidentCore), \
+        "fully pos-free aggregate should route to the host core"
+
+
+def test_posfree_aggregate_forced_device_routes_python():
+    """A fully pos-free MultiReducer FORCED onto the device
+    (use_resident=True past the host route) needs the Python core's
+    ship-the-position-column fallback — the native gate must not claim
+    it (review r5: dev_parts empty slipped the vacuous field-count
+    clause and raised in NativeResidentCore.__init__)."""
+    from windflow_tpu.ops.functions import MultiReducer
+    from windflow_tpu.patterns.win_seq_tpu import ResidentWinSeqCore
+    free = MultiReducer(("count", None, "n"), ("min", "id", "lo"),
+                        ("max", "id", "hi"))
+    spec = WindowSpec(16, 4, WinType.CB)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        core = make_core_for(spec, free, batch_len=64, flush_rows=150,
+                             use_resident=True)
+    assert isinstance(core, ResidentWinSeqCore)
+    batches = cb_stream(3, 300, chunk=71, seed=53)
+    host = run_core(WinSeqCore(spec, MultiReducer(
+        ("count", None, "n"), ("min", "id", "lo"),
+        ("max", "id", "hi"))), batches)
+    got = run_core(core, batches)
+    assert len(host) == len(got)
+    for f in ("key", "id", "n", "lo", "hi"):
+        np.testing.assert_array_equal(host[f], got[f], err_msg=f)

@@ -178,22 +178,28 @@ def test_ysb_wmr_tpu_differential():
     assert sorted(a.rows) == sorted(b.rows)
 
 
-def test_rich_stats_routes_to_multifield_executor():
-    """device_aggregate(rich=True) must keep selecting the single-device
-    multi-field resident path: MIN(ts) is real device work on the ts
-    ring (not answerable by the pos-max split), making the device half
-    two fields.  Pins the routing BASELINE.md's real-chip row documents."""
+def test_rich_stats_min_ts_is_host_free():
+    """r5 (second half): MIN over the position field is as free as MAX —
+    the position-ordered archive's first window row holds it — so
+    device_aggregate(rich=True)'s firstUpdate no longer ships the ts
+    column: the device half collapses back to the single revenue ring
+    and BOTH extremes ride the pos-extrema split.  (The multi-field
+    device path stays exercised by tests/test_native.py's multifield
+    suite and the recorded on-chip A/B, BASELINE.md round 5.)"""
     import warnings
 
     from windflow_tpu.apps.ysb import device_aggregate
     from windflow_tpu.core.windows import WindowSpec, WinType
-    from windflow_tpu.ops.resident import MultiFieldResidentExecutor
-    from windflow_tpu.patterns.win_seq_tpu import make_core_for
+    from windflow_tpu.patterns.win_seq_tpu import make_core_for, \
+        split_pos_max
 
+    spec = WindowSpec(10_000_000, 10_000_000, WinType.TB)
+    agg = device_aggregate(rich=True)
+    dev, pos = split_pos_max(spec, agg)
+    assert [p.field for p in dev] == ["revenue"]
+    assert sorted((p.op, p.out_field) for p in pos) == [
+        ("max", "lastUpdate"), ("min", "firstUpdate")]
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        core = make_core_for(WindowSpec(10_000_000, 10_000_000, WinType.TB),
-                             device_aggregate(rich=True), batch_len=256)
-    ex = getattr(core, "executor", None)
-    assert isinstance(ex, MultiFieldResidentExecutor)
-    assert set(ex.fields) == {"revenue", "ts"}
+        core = make_core_for(spec, agg, batch_len=256)
+    assert getattr(core, "_ship_fields", None) == ("revenue",)

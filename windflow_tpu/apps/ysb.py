@@ -147,12 +147,14 @@ def device_aggregate(rich: bool = False):
                 value_range=(0, 2_100_000_000)),
         Reducer("sum", "revenue", "revenue", value_range=(0, 98))]
     if rich:
-        # --rich-stats: MIN(ts) = the window's earliest event.  Unlike
-        # MAX over the position field (answered host-side by the pos-max
-        # split), a MIN over ts is real device work on the ts ring, so
-        # the aggregate's device half becomes TWO fields (ts + revenue)
-        # and routes through MultiFieldResidentExecutor — the path
-        # VERDICT r4 weak #5 flagged as perf-unmeasured on real hardware
+        # --rich-stats: MIN(ts) = the window's earliest event.  Since the
+        # r5 pos-extrema split, MIN over the position field is as free as
+        # MAX — the position-ordered archive's first window row holds it
+        # — so firstUpdate costs nothing and the device half stays the
+        # single revenue ring.  (It briefly shipped ts as a second device
+        # field, which is how the multi-field path got its on-chip
+        # measurement — BASELINE.md round 5; that path remains exercised
+        # by tests/test_native.py's multifield suite.)
         stats.append(Reducer("min", "ts", "firstUpdate",
                              value_range=(0, 2_100_000_000)))
     return MultiReducer(*stats)

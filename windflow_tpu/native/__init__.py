@@ -112,7 +112,8 @@ def _bind(lib):
     lib.wf_launch_take_padded_f.restype = None
     lib.wf_launch_take_padded_f.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), i64, i64,
-        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64, p_i64]
+        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64, p_i64,
+        p_i64]
     lib.wf_launch_pending.restype = i64
     lib.wf_launch_pending.argtypes = [ctypes.c_void_p]
     lib.wf_launch_peek.restype = ctypes.c_int
@@ -123,7 +124,8 @@ def _bind(lib):
                                    p_i64, p_i64, p_i64, p_i64]
     lib.wf_launch_take_padded.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, i64, i64,
-        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64, p_i64]
+        p_i64, p_i32, p_i32, p_i32, p_i64, p_i64, p_i64, p_i64, p_i64,
+        p_i64]
     lib.wf_launch_peek_regular.restype = ctypes.c_int
     lib.wf_launch_peek_regular.argtypes = [ctypes.c_void_p, p_i64]
     lib.wf_launch_coalesce.restype = i64

@@ -614,7 +614,11 @@ class NativeResidentCore:
         hts = np.empty(max(B, 1), dtype=np.int64)
         hlen = np.empty(max(B, 1), dtype=np.int64)
         hpm = (np.empty(max(B, 1), dtype=np.int64)
-               if self._pos_max_parts else None)
+               if any(p.op == "max" for p in self._pos_max_parts)
+               else None)
+        hpmn = (np.empty(max(B, 1), dtype=np.int64)
+                if any(p.op == "min" for p in self._pos_max_parts)
+                else None)
         p32 = ctypes.POINTER(ctypes.c_int32)
         p64 = ctypes.POINTER(ctypes.c_longlong)
         regular = False
@@ -648,7 +652,8 @@ class NativeResidentCore:
                     wstarts_p, wlens_p,
                     hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
                     hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64),
-                    hpm.ctypes.data_as(p64) if hpm is not None else None)
+                    hpm.ctypes.data_as(p64) if hpm is not None else None,
+                    hpmn.ctypes.data_as(p64) if hpmn is not None else None)
             else:
                 lib.wf_launch_take_padded(
                     handle, blk.ctypes.data_as(ctypes.c_void_p), KPp, Rb,
@@ -656,7 +661,8 @@ class NativeResidentCore:
                     wstarts_p, wlens_p,
                     hkey.ctypes.data_as(p64), hid.ctypes.data_as(p64),
                     hts.ctypes.data_as(p64), hlen.ctypes.data_as(p64),
-                    hpm.ctypes.data_as(p64) if hpm is not None else None)
+                    hpm.ctypes.data_as(p64) if hpm is not None else None,
+                    hpmn.ctypes.data_as(p64) if hpmn is not None else None)
         if rebase.value:
             ex.reset(max(K, 1), cap.value)
         if getattr(ex, "mesh", None) is not None:
@@ -668,7 +674,8 @@ class NativeResidentCore:
             if blks is not None:
                 blks = {f: b[:K] for f, b in blks.items()}
         meta = (hkey[:B], hid[:B], hts[:B], hlen[:B],
-                hpm[:B] if hpm is not None else None)
+                hpm[:B] if hpm is not None else None,
+                hpmn[:B] if hpmn is not None else None)
         if self._multi:
             ex.launch(meta, blks, offs, wrows[:B], wstarts[:B], wlens[:B])
         elif regular:
@@ -685,7 +692,7 @@ class NativeResidentCore:
             return np.zeros(0, dtype=self._result_dtype)
         from .win_seq_tpu import finalize_window_values
         outs = []
-        for (hkey, hid, hts, hlen, hpm), out in harvested:
+        for (hkey, hid, hts, hlen, hpm, hpmn), out in harvested:
             # multi executors return one array per stat (dev_parts
             # order); the single path returns the stat array itself
             arrs = out if isinstance(out, tuple) else (out,)
@@ -698,7 +705,7 @@ class NativeResidentCore:
             for part in self._count_parts:
                 res[part.out_field] = hlen.astype(part.dtype)
             for part in self._pos_max_parts:
-                res[part.out_field] = finalize_window_values(part, hpm,
-                                                             hlen)
+                res[part.out_field] = finalize_window_values(
+                    part, hpm if part.op == "max" else hpmn, hlen)
             outs.append(res)
         return outs[0] if len(outs) == 1 else np.concatenate(outs)

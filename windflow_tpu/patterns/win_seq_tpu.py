@@ -487,12 +487,15 @@ class ResidentWinSeqCore(WinSeqCore):
         self._wdesc.append((key, lo + live_start, (hi - lo).astype(np.int64),
                             gwids))
         if self._pos_max_parts and len(p):
-            # MAX over the position field, free from the ordered archive:
-            # the window's last row holds it (empty windows fixed up to
-            # the identity at harvest, finalize_window_values)
-            pm = p[np.minimum(np.maximum(hi - 1, 0), len(p) - 1)]
+            # MAX/MIN over the position field, free from the ordered
+            # archive: the window's last row holds the max and its first
+            # row the min (empty windows fixed up to the identity at
+            # harvest, finalize_window_values)
+            pm = (p[np.minimum(np.maximum(hi - 1, 0), len(p) - 1)],
+                  p[np.minimum(lo, len(p) - 1)])
         else:
-            pm = np.zeros(len(lwids), dtype=np.int64)
+            z = np.zeros(len(lwids), dtype=np.int64)
+            pm = (z, z)
         self._hdr.append((key, ids, ts, (hi - lo).astype(np.int64), pm))
         self._n_wins += len(lwids)
         if not eos and len(lwids):
@@ -646,7 +649,8 @@ class ResidentWinSeqCore(WinSeqCore):
                     payload[p.out_field] = lens.astype(p.dtype)
                 for p in self._pos_max_parts:
                     payload[p.out_field] = finalize_window_values(
-                        p, pos_max, lens)
+                        p, pos_max[0] if p.op == "max" else pos_max[1],
+                        lens)
                 outs.append(self._make_results(key, ids, ts, payload))
                 off += n
         return outs
@@ -688,25 +692,31 @@ _RESIDENT_OPS = ("sum", "min", "max", "prod")
 
 def split_pos_max(spec: WindowSpec, reducer: MultiReducer):
     """Partition a MultiReducer's non-count stats into (device_parts,
-    pos_max_parts): MAX over the POSITION field (ts for TB, id for CB) is
-    free from the position-ordered archive — the window's last row holds
-    it — so it never needs to ship (e.g. YSB's COUNT + MAX(ts) +
-    SUM(revenue) ships only the revenue column)."""
+    pos_extremum_parts): MAX *and MIN* over the POSITION field (ts for
+    TB, id for CB) are free from the position-ordered archive — the
+    window's last row holds the max and its FIRST row the min — so
+    neither ever ships (e.g. YSB's COUNT + MAX(ts) + SUM(revenue) ships
+    only the revenue column, and a `firstUpdate` MIN(ts) costs nothing
+    either).  Harvesters pick the per-window last/first-row array by
+    each returned part's ``op``."""
     pos_field = "id" if spec.win_type is WinType.CB else "ts"
     dev = reducer.device_parts
-    pos = [p for p in dev if p.op == "max" and p.field == pos_field]
+    pos = [p for p in dev
+           if p.op in ("max", "min") and p.field == pos_field]
     return [p for p in dev if p not in pos], pos
 
 
 def _host_free(spec: WindowSpec, winfunc) -> bool:
     """True when every stat is free on the host: counts come from window
-    lengths, and ``max`` over the POSITION field (ts for TB, id for CB) is
-    the last archived row's value — archives are kept ordered by position
-    (stream_archive.hpp), so the host bookkeeping already holds the
-    answer.  Such aggregates have no device-worthy compute at all."""
+    lengths, and ``max``/``min`` over the POSITION field (ts for TB, id
+    for CB) are the last/first archived row's values — archives are kept
+    ordered by position (stream_archive.hpp), so the host bookkeeping
+    already holds the answers.  Such aggregates have no device-worthy
+    compute at all."""
     pos_field = "id" if spec.win_type is WinType.CB else "ts"
     parts = winfunc.parts if isinstance(winfunc, MultiReducer) else [winfunc]
-    return all(p.op == "count" or (p.op == "max" and p.field == pos_field)
+    return all(p.op == "count"
+               or (p.op in ("max", "min") and p.field == pos_field)
                for p in parts)
 
 
@@ -806,7 +816,11 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
         dev_parts, _pos = split_pos_max(spec, winfunc)
         from ..native import enabled
         _nat = enabled()
-        if (_nat is not None
+        if (_nat is not None and dev_parts
+                # dev_parts empty = a fully pos-free aggregate FORCED onto
+                # the device (use_resident=True/mesh past the host route):
+                # only the Python core has the ship-the-position-column
+                # fallback for that shape
                 and (len(dev_parts) == 1
                      or (len({p.field for p in dev_parts})
                          <= int(_nat.wf_max_fields())
