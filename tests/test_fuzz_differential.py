@@ -116,22 +116,26 @@ def test_fuzz_device_cores(seed):
 
 @pytest.mark.parametrize("seed", range(8))
 def test_fuzz_multireducer(seed):
-    """Multi-stat aggregates under random shapes: count + max + sum must
-    match the oracle through whatever core the selection picks — incl.
-    the pos-max split paths when the max targets the position field."""
+    """Multi-stat aggregates under random shapes: count + max + min + sum
+    must match the oracle through whatever core the selection picks —
+    incl. the pos-extrema split paths when an extremum targets the
+    position field (host-free both ways since r5) and the native
+    multi-field staging when the stats span several device columns."""
     from windflow_tpu.ops.functions import MultiReducer
     from windflow_tpu.patterns.win_seq import WinSeq
     win, slide, wt, n_keys, _op, role, cfg, mi, skw = draw_config(seed)
     rng = np.random.default_rng(3000 + seed)
     chunks = make_stream(rng, n_keys, 4, 140, **skw)
     spec = WindowSpec(win, slide, wt)
-    # alternate the max target between the position field (ts for TB,
-    # id for CB — host-free) and the value column (device-worthy)
-    max_field = ("ts" if wt is WinType.TB else "id") if seed % 2 \
-        else "value"
+    # alternate each extremum's target between the position field (ts
+    # for TB, id for CB — host-free) and the value column (device-worthy)
+    pos_field = "ts" if wt is WinType.TB else "id"
+    max_field = pos_field if seed % 2 else "value"
+    min_field = pos_field if (seed // 2) % 2 else "value"
 
     def agg():
         return MultiReducer(("count", None, "n"), ("max", max_field, "mx"),
+                            ("min", min_field, "mn"),
                             ("sum", "value", "sm"))
 
     oracle = run_core(WinSeqCore(spec, agg(), config=cfg, role=role,
@@ -139,6 +143,17 @@ def test_fuzz_multireducer(seed):
     got = run_core(WinSeq(agg(), win, slide, wt, config=cfg, role=role,
                           map_indexes=mi).make_core(), chunks)
     assert_equivalent(got, oracle)
+    # the DEVICE selection is where the pos-extrema split and the native
+    # multi-field staging actually live (make_core_for, not
+    # WinSeq.make_core — which only picks host cores); run it against
+    # the same oracle so those paths are genuinely fuzz-covered
+    from windflow_tpu.patterns.win_seq_tpu import make_core_for
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dev_core = make_core_for(spec, agg(), config=cfg, role=role,
+                                 map_indexes=mi, batch_len=64,
+                                 flush_rows=200)
+    assert_equivalent(run_core(dev_core, chunks), oracle)
 
 
 @pytest.mark.parametrize("seed", range(6))
