@@ -1033,3 +1033,36 @@ def test_posfree_aggregate_forced_device_routes_python():
     assert len(host) == len(got)
     for f in ("key", "id", "n", "lo", "hi"):
         np.testing.assert_array_equal(host[f], got[f], err_msg=f)
+
+
+def test_native_abi_guards():
+    """ABI misuse is a defined error, not UB: the single-field process
+    entry on a multi-field core returns -1 (review r5: it previously
+    dereferenced the missing offsets), and wf_core_set_fields reports
+    the accepted count so callers can refuse a short accept."""
+    import ctypes
+
+    from windflow_tpu import native as nat
+    lib = nat.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    assert int(lib.wf_max_fields()) == 4
+    h = lib.wf_core_new(8, 8, 0, 0, 0, 1, 8, 0, 1, 8, 0, 1, 8,
+                        1 << 20, 64, 2)
+    try:
+        mw = (ctypes.c_int * 2)(2, 2)
+        assert lib.wf_core_set_fields(h, 2, mw) == 2
+        assert lib.wf_core_set_fields(h, 9, None) == 4  # clamped accept
+        lib.wf_core_set_fields(h, 2, mw)
+        b = batch_from_columns(
+            MF_SCHEMA, key=np.zeros(16, dtype=np.int64),
+            id=np.arange(16), ts=np.arange(16),
+            rev=np.ones(16, dtype=np.int64),
+            amt=np.ones(16, dtype=np.int64))
+        f = b.dtype.fields
+        got = lib.wf_core_process(
+            h, b.ctypes.data, len(b), b.dtype.itemsize, f["key"][1],
+            f["id"][1], f["ts"][1], f["marker"][1], f["rev"][1])
+        assert got == -1, "single-field entry on a 2-field core must refuse"
+    finally:
+        lib.wf_core_free(h)
