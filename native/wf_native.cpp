@@ -827,6 +827,47 @@ struct NativeQueue {
         return 0;
     }
 
+    // Overload-policy entry points (runtime/overload.py): non-blocking and
+    // deadline-bounded variants.  Return codes: 0 = done, 1 = would block
+    // (full / empty / deadline expired), -1 = closed.
+
+    int try_push(i64 src, i64 slot) {
+        std::lock_guard<std::mutex> lk(mu);
+        if (closed) return -1;
+        if (count >= cap) return 1;
+        buf[(head + count) % cap] = {src, slot};
+        ++count;
+        cv_items.notify_one();
+        return 0;
+    }
+
+    int push_timed(i64 src, i64 slot, i64 timeout_ms) {
+        std::unique_lock<std::mutex> lk(mu);
+        ++waiters;
+        bool ready = cv_space.wait_for(
+            lk, std::chrono::milliseconds(timeout_ms),
+            [&] { return count < cap || closed; });
+        --waiters;
+        if (closed) return -1;
+        if (!ready) return 1;
+        buf[(head + count) % cap] = {src, slot};
+        ++count;
+        cv_items.notify_one();
+        return 0;
+    }
+
+    int try_pop(i64 *src, i64 *slot) {
+        std::lock_guard<std::mutex> lk(mu);
+        if (count == 0) return closed ? -1 : 1;
+        auto &e = buf[head];
+        *src = e.first;
+        *slot = e.second;
+        head = (head + 1) % cap;
+        --count;
+        cv_space.notify_one();
+        return 0;
+    }
+
     void close() {
         std::lock_guard<std::mutex> lk(mu);
         closed = true;
@@ -864,6 +905,18 @@ int wf_queue_push(void *h, i64 src, i64 slot) {
 
 int wf_queue_pop(void *h, i64 *src, i64 *slot) {
     return ((NativeQueue *)h)->pop(src, slot);
+}
+
+int wf_queue_try_push(void *h, i64 src, i64 slot) {
+    return ((NativeQueue *)h)->try_push(src, slot);
+}
+
+int wf_queue_push_timed(void *h, i64 src, i64 slot, i64 timeout_ms) {
+    return ((NativeQueue *)h)->push_timed(src, slot, timeout_ms);
+}
+
+int wf_queue_try_pop(void *h, i64 *src, i64 *slot) {
+    return ((NativeQueue *)h)->try_pop(src, slot);
 }
 
 void wf_queue_close(void *h) { ((NativeQueue *)h)->close(); }

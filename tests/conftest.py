@@ -19,3 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 if "jax" in sys.modules:
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md); register the marker so
+    # soak tests deselect cleanly instead of warning about an unknown mark
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/stress tests, excluded from the tier-1 "
+        "suite (-m 'not slow')")

@@ -1,0 +1,327 @@
+"""Channel fault-injection tests (parallel/channel.py hardening): every
+failure mode must surface as a *fast, classified error* — bounded
+wall-clock, never an indefinite hang, never a silent truncation passed
+off as clean EOS.  The wall-clock bounds are generous (CI jitter) but
+orders of magnitude below "hang"."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.parallel.channel import (_LEN, ChannelError, PeerAbort,
+                                           PeerStall, RowReceiver,
+                                           RowSender, WireConfig,
+                                           _encode_dtype)
+
+SCHEMA = Schema(value=np.int64)
+
+
+def mk_batch(n=8, lo=0):
+    ids = np.arange(lo, lo + n)
+    return batch_from_columns(SCHEMA, key=np.zeros(n), id=ids, ts=ids,
+                              value=ids)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------- connect retries
+
+def test_connection_refused_without_deadline_fails_immediately():
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        RowSender("127.0.0.1", free_port())
+    assert time.monotonic() - t0 < 5
+
+
+def test_connection_refused_with_deadline_bounded():
+    """Backoff retries stop at the total deadline with a clear error —
+    not one attempt, not forever."""
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="attempts"):
+        RowSender("127.0.0.1", free_port(), connect_deadline=0.5)
+    dt = time.monotonic() - t0
+    assert 0.3 < dt < 10
+
+
+def test_connect_retry_reaches_late_receiver():
+    """Peers boot in any order: a sender started BEFORE its receiver
+    connects once the receiver comes up (exponential backoff + jitter)."""
+    port = free_port()
+    out = {}
+
+    def late_boot():
+        time.sleep(0.4)
+        out["recv"] = RowReceiver(n_senders=1, port=port)
+
+    t = threading.Thread(target=late_boot)
+    t.start()
+    snd = RowSender("127.0.0.1", port, connect_deadline=30)
+    t.join()
+    snd.send(mk_batch())
+    snd.close()
+    got = list(out["recv"].batches())
+    assert len(got) == 1 and got[0]["value"].sum() == 28
+
+
+# ------------------------------------------------------------ peer death
+
+def test_receiver_killed_mid_stream_fails_sender_fast():
+    """A receiver that dies mid-stream surfaces as an OSError on the
+    sender's send path within bounded time — not a hang, not silence."""
+    recv = RowReceiver(n_senders=1)
+    snd = RowSender("127.0.0.1", recv.port)
+    snd.send(mk_batch())
+    recv.close()
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        # TCP buffers the first post-mortem sends; the RST lands within
+        # a few round trips
+        for i in range(1000):
+            snd.send(mk_batch(lo=i))
+            time.sleep(0.001)
+    assert time.monotonic() - t0 < 30
+
+
+def test_sender_killed_mid_stream_fails_receiver():
+    """Hard sender death without EOS is an error from batches(), never a
+    clean (truncated) end of stream."""
+    recv = RowReceiver(n_senders=1)
+
+    def half_send():
+        snd = RowSender("127.0.0.1", recv.port)
+        snd.send(mk_batch())
+        snd._sock.shutdown(socket.SHUT_RDWR)
+        snd._sock.close()
+
+    t = threading.Thread(target=half_send)
+    t.start()
+    with pytest.raises((ConnectionError, OSError)):
+        list(recv.batches())
+    t.join()
+
+
+def test_close_on_dead_peer_is_flagged_not_clean():
+    """RowSender.close() must SURFACE an undeliverable EOS (peer already
+    dead) instead of reporting a clean shutdown."""
+    recv = RowReceiver(n_senders=1)
+    snd = RowSender("127.0.0.1", recv.port)
+    snd.send(mk_batch())
+
+    class DeadSock:
+        def sendall(self, data):
+            raise BrokenPipeError("peer gone")
+
+        def close(self):
+            pass
+
+    snd._sock.close()
+    snd._sock = DeadSock()
+    assert snd.failed is None
+    with pytest.raises(ChannelError, match="not delivered"):
+        snd.close()
+    assert isinstance(snd.failed, OSError)
+
+
+def test_never_connected_sender_bounded_by_accept_timeout():
+    """A peer that dies before EVER connecting must surface within the
+    accept window — not hang batches() forever waiting for accept()."""
+    recv = RowReceiver(n_senders=1, accept_timeout=0.5)
+    t0 = time.monotonic()
+    with pytest.raises(PeerStall, match="0/1 senders"):
+        list(recv.batches())
+    assert time.monotonic() - t0 < 10
+
+
+def test_receiver_close_wakes_blocked_batches():
+    """close() during the accept phase must wake a consumer blocked in
+    batches() with a classified error, not leave it blocked forever."""
+    recv = RowReceiver(n_senders=1)
+    result = {}
+
+    def consume():
+        try:
+            list(recv.batches())
+            result["err"] = None
+        except Exception as e:  # noqa: BLE001 — asserted below
+            result["err"] = e
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    recv.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "batches() still blocked after close()"
+    assert isinstance(result["err"], ChannelError)
+
+
+def test_connect_deadline_clamps_attempt_timeout():
+    """The per-attempt socket timeout is clamped to the remaining
+    deadline, so a blackholed host cannot overshoot the bound by a whole
+    attempt (attempt timeout 30s vs deadline 0.6s)."""
+    # 10.255.255.1 is a non-routable address: SYNs are dropped silently
+    # (blackhole) on typical CI hosts; if the network answers fast with
+    # RST instead, the test still passes through the refused path
+    t0 = time.monotonic()
+    with pytest.raises((ConnectionError, OSError)):
+        RowSender("10.255.255.1", 9, timeout=30.0, connect_deadline=0.6)
+    assert time.monotonic() - t0 < 10
+
+
+# -------------------------------------------------------- frame protocol
+
+def test_truncated_frame_is_an_error():
+    """A frame header promising more bytes than ever arrive must raise,
+    not hang or truncate."""
+    recv = RowReceiver(n_senders=1)
+    raw = socket.create_connection(("127.0.0.1", recv.port))
+    d = _encode_dtype(mk_batch().dtype)
+    raw.sendall(_LEN.pack(len(d)) + d)
+    raw.sendall(_LEN.pack(100) + b"only ten b")
+    raw.close()
+    with pytest.raises(ConnectionError, match="mid-frame"):
+        list(recv.batches())
+
+
+def test_garbage_frame_length_is_an_error():
+    recv = RowReceiver(n_senders=1)
+    raw = socket.create_connection(("127.0.0.1", recv.port))
+    raw.sendall(_LEN.pack(-7))
+    with pytest.raises(ChannelError, match="bad row-channel frame"):
+        list(recv.batches())
+    raw.close()
+
+
+def test_abort_frame_distinguishable_from_eos():
+    """abort() is the failure-path close: the receiver classifies it as
+    PeerAbort (truncated prefix), NOT as a clean EOS."""
+    recv = RowReceiver(n_senders=1)
+    snd = RowSender("127.0.0.1", recv.port)
+    snd.send(mk_batch())
+    snd.abort()
+    got = []
+    with pytest.raises(PeerAbort, match="truncated"):
+        for b in recv.batches():
+            got.append(b)
+    assert len(got) == 1    # data before the abort is delivered, flagged
+
+
+# ------------------------------------------------------------ heartbeats
+
+def test_heartbeat_stall_timeout_bounded():
+    """A peer that goes silent mid-stream (no data, no heartbeat) trips
+    the receiver's stall timeout within bounded wall-clock — the
+    _read_exact-hangs-forever failure mode of the un-hardened channel."""
+    recv = RowReceiver(n_senders=1, stall_timeout=0.5)
+    raw = socket.create_connection(("127.0.0.1", recv.port))
+    d = _encode_dtype(mk_batch().dtype)
+    raw.sendall(_LEN.pack(len(d)) + d)
+    t0 = time.monotonic()
+    with pytest.raises(PeerStall, match="silent"):
+        list(recv.batches())
+    dt = time.monotonic() - t0
+    assert dt < 10
+    raw.close()
+
+
+def test_heartbeats_keep_idle_link_alive():
+    """An idle-but-alive sender (heartbeat < stall timeout) must NOT trip
+    the stall timeout; the stream completes cleanly after the idle gap."""
+    recv = RowReceiver(n_senders=1, stall_timeout=0.6)
+    snd = RowSender("127.0.0.1", recv.port, heartbeat=0.1)
+    err = []
+
+    def feed():
+        try:
+            snd.send(mk_batch())
+            time.sleep(1.3)         # > 2x stall timeout, bridged by beats
+            snd.send(mk_batch(lo=100))
+            snd.close()
+        except Exception as e:  # noqa: BLE001 — surfaced via main thread
+            err.append(e)
+
+    t = threading.Thread(target=feed)
+    t.start()
+    got = list(recv.batches())
+    t.join()
+    assert not err
+    assert len(got) == 2
+
+
+def test_wire_config_defaults():
+    w = WireConfig()
+    assert (w.connect_deadline, w.heartbeat, w.stall_timeout) \
+        == (None, None, None)       # bare = seed-identical protocol
+    h = WireConfig.hardened()
+    assert h.connect_deadline and h.heartbeat and h.stall_timeout
+    assert h.stall_timeout >= 3 * h.heartbeat
+
+
+# ----------------------------------------------- dataflow integration
+
+def test_peer_death_surfaces_in_dataflow_errors():
+    """The acceptance-criteria path: a multihost source feeding from a
+    row channel whose peer stalls -> wait() raises within the stall
+    timeout; the error lands in Dataflow._errors, no hang."""
+    from windflow_tpu.patterns.basic import Sink, Source
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+
+    recv = RowReceiver(n_senders=1, stall_timeout=0.5)
+    raw = socket.create_connection(("127.0.0.1", recv.port))
+    d = _encode_dtype(mk_batch().dtype)
+    raw.sendall(_LEN.pack(len(d)) + d)
+    payload = np.ascontiguousarray(mk_batch()).tobytes()
+    raw.sendall(_LEN.pack(len(payload)) + payload)
+    # ... then the peer stalls mid-stream, forever
+
+    df = Dataflow("wire", capacity=4)
+    build_pipeline(df, [Source(batches=recv.batches(), schema=SCHEMA),
+                        Sink(lambda rows: None, vectorized=True)])
+    t0 = time.monotonic()
+    with pytest.raises(PeerStall):
+        df.run_and_wait_end()
+    assert time.monotonic() - t0 < 10
+    assert any(isinstance(e, PeerStall) for e in df._errors)
+    raw.close()
+
+
+def test_open_row_plane_two_ends():
+    """multihost.open_row_plane builds a full hardened plane in any boot
+    order; a clean run round-trips, and closing is clean."""
+    from windflow_tpu.parallel.multihost import open_row_plane
+
+    addrs = {0: ("127.0.0.1", free_port()), 1: ("127.0.0.1", free_port())}
+    wire = WireConfig(connect_deadline=30, heartbeat=0.2, stall_timeout=2.0)
+    planes = {}
+
+    def boot(pid):
+        planes[pid] = open_row_plane(pid, addrs, wire=wire)
+
+    threads = [threading.Thread(target=boot, args=(p,)) for p in (1, 0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    r0, send0 = planes[0]
+    r1, send1 = planes[1]
+    send0[1].send(mk_batch())
+    send0[1].close()
+    send1[0].close()
+    assert len(list(r1.batches())) == 1
+    assert list(r0.batches()) == []
+
+
+def test_open_row_plane_rejects_unknown_pid():
+    with pytest.raises(KeyError, match="no entry"):
+        from windflow_tpu.parallel.multihost import open_row_plane
+        open_row_plane(7, {0: ("127.0.0.1", 1)})

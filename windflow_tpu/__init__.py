@@ -31,6 +31,7 @@ from .patterns.win_seq_tpu import (JaxWindowFunction, KeyFarmTPU,
                                    PaneFarmTPU, WinFarmTPU, WinMapReduceTPU,
                                    WinSeqTPU)
 from .runtime.node import RuntimeContext
+from .runtime.overload import DeadLetter, OverloadError, OverloadPolicy
 
 __version__ = "0.1.0"
 
@@ -54,4 +55,6 @@ __all__ = [
     "WinMapReduce_Builder", "WinSeqTPU_Builder", "WinFarmTPU_Builder",
     "KeyFarmTPU_Builder", "PaneFarmTPU_Builder", "WinMapReduceTPU_Builder",
     "LEVEL0", "LEVEL1", "LEVEL2",
+    # robustness (docs/ROBUSTNESS.md)
+    "OverloadPolicy", "OverloadError", "DeadLetter",
 ]

@@ -97,6 +97,32 @@ class _VectorizedMixin:
         return self
 
 
+class _ErrorBudgetMixin:
+    """Poison-tuple quarantine knob (runtime/overload.py, no reference
+    analog — FastFlow tears the farm down on any svc error).  Applies to
+    the operator's worker replicas; stamped on the built pattern and
+    propagated per node by runtime/farm.py."""
+
+    def withErrorBudget(self, n: int):
+        """Allow each replica to quarantine up to `n` failing batches to
+        the dataflow's dead-letter queue before failing fast."""
+        n = int(n)
+        if n < 0:
+            raise ValueError("error budget must be >= 0")
+        self._error_budget = n
+        return self
+
+    def build(self):
+        pattern = super().build()
+        budget = getattr(self, "_error_budget", None)
+        if budget is not None:
+            pattern.error_budget = budget
+        return pattern
+
+    build_ptr = build
+    build_unique = build
+
+
 # ------------------------------------------------------------ basic patterns
 
 class Source_Builder(_Builder, _ParallelMixin, _RichMixin):
@@ -128,8 +154,8 @@ class Source_Builder(_Builder, _ParallelMixin, _RichMixin):
         return self
 
 
-class Filter_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
-                     _VectorizedMixin):
+class Filter_Builder(_ErrorBudgetMixin, _Builder, _ParallelMixin,
+                     _RichMixin, _KeyByMixin, _VectorizedMixin):
     """builders.hpp:139."""
     _pattern_cls = Filter
 
@@ -138,8 +164,8 @@ class Filter_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
         self._kw["fn"] = fn
 
 
-class Map_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
-                  _VectorizedMixin):
+class Map_Builder(_ErrorBudgetMixin, _Builder, _ParallelMixin, _RichMixin,
+                  _KeyByMixin, _VectorizedMixin):
     """builders.hpp:247."""
     _pattern_cls = Map
 
@@ -154,8 +180,8 @@ class Map_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
         return self
 
 
-class FlatMap_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
-                      _VectorizedMixin):
+class FlatMap_Builder(_ErrorBudgetMixin, _Builder, _ParallelMixin,
+                      _RichMixin, _KeyByMixin, _VectorizedMixin):
     """builders.hpp:356."""
     _pattern_cls = FlatMap
 
@@ -168,7 +194,8 @@ class FlatMap_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
         return self
 
 
-class Accumulator_Builder(_Builder, _ParallelMixin, _RichMixin):
+class Accumulator_Builder(_ErrorBudgetMixin, _Builder, _ParallelMixin,
+                          _RichMixin):
     """builders.hpp:465."""
     _pattern_cls = Accumulator
 
@@ -189,8 +216,8 @@ class Accumulator_Builder(_Builder, _ParallelMixin, _RichMixin):
         return self
 
 
-class Sink_Builder(_Builder, _ParallelMixin, _RichMixin, _KeyByMixin,
-                   _VectorizedMixin):
+class Sink_Builder(_ErrorBudgetMixin, _Builder, _ParallelMixin, _RichMixin,
+                   _KeyByMixin, _VectorizedMixin):
     """builders.hpp:2186."""
     _pattern_cls = Sink
 

@@ -60,6 +60,13 @@ class _FusedPattern:
         self.group = group
         self.parallelism = group[0].parallelism
         self.name = "+".join(p.name for p in group)
+        # a fused chain runs every member in ONE thread, so one svc error
+        # quarantines the chain's whole input batch: honor the tightest
+        # member budget rather than silently dropping withErrorBudget
+        budgets = [p.error_budget for p in group
+                   if getattr(p, "error_budget", None) is not None]
+        if budgets:
+            self.error_budget = min(budgets)
 
     def replicas(self):
         per = [p.replicas() for p in self.group]
@@ -78,13 +85,17 @@ class MultiPipe:
     operands of :func:`union_multipipes`."""
 
     def __init__(self, name: str = "pipe", trace_dir: str = None,
-                 capacity: int = 16):
+                 capacity: int = 16, overload=None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
         #: latency/throughput knob — buffered tuples ~= stages x capacity
         #: x chunk, so end-to-end latency ~= that over the throughput
         self.capacity = capacity
+        #: runtime/overload.OverloadPolicy — shedding / put deadlines /
+        #: poison quarantine for the materialised graph; None (default)
+        #: keeps seed-identical behavior (docs/ROBUSTNESS.md)
+        self.overload = overload
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -261,7 +272,7 @@ class MultiPipe:
     def _build(self) -> Dataflow:
         if self._df is None:
             df = Dataflow(self.name, capacity=self.capacity,
-                      trace_dir=self.trace_dir)
+                      trace_dir=self.trace_dir, overload=self.overload)
             self._build_into(df)
             self._df = df
         return self._df
@@ -283,6 +294,17 @@ class MultiPipe:
             df.wait()
         else:
             df.run_and_wait_end()
+
+    @property
+    def dead_letters(self):
+        """Quarantined poison batches (engine DeadLetter records) — only
+        populated when an error budget is set; inspect after wait()."""
+        return self._df.dead_letters if self._df is not None else []
+
+    def shed_counts(self) -> dict:
+        """Per-node shed counters of the materialised graph (empty before
+        run() and under the default blocking policy)."""
+        return self._df.shed_counts() if self._df is not None else {}
 
     def getNumThreads(self) -> int:
         """Thread count of the materialised graph (multipipe.hpp:973).
@@ -317,7 +339,20 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
             raise ValueError(f"cannot union {p.name!r}: already running")
     # the merged pipe builds ONE Dataflow for the whole graph, so the
     # tightest operand capacity wins (a per-branch latency tuning must not
-    # be silently widened back to the default)
-    merged = MultiPipe(name, capacity=min(p.capacity for p in pipes))
+    # be silently widened back to the default).  Overload policies have no
+    # such merge rule: distinct configured policies would silently drop
+    # one author's knobs, so they must agree (or all but one be unset)
+    policies = [p.overload for p in pipes if p.overload is not None]
+    overload = policies[0] if policies else None
+    for pol in policies[1:]:
+        if (pol.shed, pol.put_deadline, pol.error_budget) != (
+                overload.shed, overload.put_deadline,
+                overload.error_budget):
+            raise ValueError(
+                f"cannot union MultiPipes with conflicting overload "
+                f"policies ({overload!r} vs {pol!r}): one Dataflow runs "
+                f"one policy — configure it on the merged pipe")
+    merged = MultiPipe(name, capacity=min(p.capacity for p in pipes),
+                       overload=overload)
     merged._branches = list(pipes)
     return merged

@@ -140,6 +140,20 @@ def _bind(lib):
     lib.wf_queue_pop.restype = ctypes.c_int
     lib.wf_queue_pop.argtypes = [ctypes.c_void_p, p_i64, p_i64]
     lib.wf_queue_close.argtypes = [ctypes.c_void_p]
+    # overload-policy entry points (runtime/overload.py) — absent from a
+    # pre-robustness .so; bind tolerantly so an old library still serves
+    # every default path and only the opt-in shed/deadline knobs fall back
+    # to the Python queue (engine._make_inbox gates on this flag)
+    try:
+        lib.wf_queue_try_push.restype = ctypes.c_int
+        lib.wf_queue_try_push.argtypes = [ctypes.c_void_p, i64, i64]
+        lib.wf_queue_push_timed.restype = ctypes.c_int
+        lib.wf_queue_push_timed.argtypes = [ctypes.c_void_p, i64, i64, i64]
+        lib.wf_queue_try_pop.restype = ctypes.c_int
+        lib.wf_queue_try_pop.argtypes = [ctypes.c_void_p, p_i64, p_i64]
+        lib.wf_has_overload_queue = True
+    except AttributeError:
+        lib.wf_has_overload_queue = False
     _lib = lib
     return _lib
 

@@ -23,20 +23,85 @@ Design notes (DCN-analog, deliberately boring):
   (cross-sender order is interleaved, as with any multi-producer edge —
   an OrderingNode downstream restores it where required);
 * EOS is an empty frame per sender; ``batches()`` ends when every
-  registered sender has closed — the FastFlow EOS cascade, one level up.
+  registered sender has closed — the FastFlow EOS cascade, one level up;
+* hardening (all opt-in; with the knobs unset the bytes on the wire and
+  the failure behavior are identical to the original protocol):
+
+  - *connect retry*: ``RowSender(connect_deadline=...)`` retries a
+    refused connection with exponential backoff + full jitter until the
+    total deadline — peers may boot in any order;
+  - *heartbeats*: ``RowSender(heartbeat=...)`` ships an empty control
+    frame (length ``-2``) whenever the link has been idle for one
+    interval, and passively probes the socket so a dead receiver
+    surfaces at the *sender* within ~one interval too;
+  - *stall timeout*: ``RowReceiver(stall_timeout=...)`` bounds how long
+    ``_read_exact`` may sit on a silent socket — a peer that stalls
+    mid-frame (or stops heartbeating) surfaces as :class:`PeerStall`
+    instead of hanging the reader forever;
+  - *abort vs EOS*: ``RowSender.abort()`` sends frame ``-3`` — the
+    receiver raises :class:`PeerAbort` instead of counting a clean EOS,
+    so a producer that died mid-stream can never silently truncate the
+    stream.
 """
 
 from __future__ import annotations
 
+import errno
 import json
 import queue
+import random
+import select
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 _LEN = struct.Struct("<q")
+
+#: control-frame codes carried in the length slot (negative = no payload)
+_EOS_FRAME = -1        # clean end-of-stream (original protocol)
+_HEARTBEAT_FRAME = -2  # liveness beacon; carries no data
+_ABORT_FRAME = -3      # sender died mid-stream: NOT a clean EOS
+
+
+class ChannelError(ConnectionError):
+    """Protocol-level row-channel failure (bad frame, dead peer)."""
+
+
+class PeerStall(ChannelError):
+    """The peer went silent past the receiver's stall timeout — neither
+    data nor heartbeat frames arrived (likely hung or partitioned)."""
+
+
+class PeerAbort(ChannelError):
+    """The peer closed with an ABORT frame: it failed mid-stream, so the
+    data received so far is a truncated prefix, not a complete stream."""
+
+
+class WireConfig:
+    """Bundle of the wire-hardening knobs, for APIs that build several
+    channels at once (``multihost.open_row_plane``).  Defaults match the
+    un-hardened seed protocol; ``WireConfig.hardened()`` gives the
+    recommended production settings (docs/ROBUSTNESS.md)."""
+
+    __slots__ = ("connect_timeout", "connect_deadline", "heartbeat",
+                 "stall_timeout")
+
+    def __init__(self, connect_timeout: float = 30.0,
+                 connect_deadline: float = None, heartbeat: float = None,
+                 stall_timeout: float = None):
+        self.connect_timeout = connect_timeout
+        self.connect_deadline = connect_deadline
+        self.heartbeat = heartbeat
+        self.stall_timeout = stall_timeout
+
+    @classmethod
+    def hardened(cls) -> "WireConfig":
+        """Production defaults: 60 s connect deadline (peers boot in any
+        order), 2 s heartbeats, 10 s stall timeout (= 5 missed beats)."""
+        return cls(connect_deadline=60.0, heartbeat=2.0, stall_timeout=10.0)
 
 
 def _encode_dtype(dtype) -> bytes:
@@ -83,52 +148,214 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-class RowSender:
-    """Client end: ships structured-array batches to a RowReceiver."""
+#: connect errnos worth retrying: the peer is not up YET (boot order) or
+#: the path is transiently unreachable.  Config mistakes — unresolvable
+#: hostname (gaierror), permission — fail immediately instead of burning
+#: the whole deadline.
+_TRANSIENT_CONNECT_ERRNOS = frozenset({
+    errno.ECONNREFUSED, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.ETIMEDOUT, errno.EHOSTUNREACH, errno.EHOSTDOWN,
+    errno.ENETUNREACH, errno.ENETDOWN, errno.EAGAIN, errno.EINTR,
+})
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+def _connect_with_backoff(host: str, port: int, timeout: float,
+                          deadline: float) -> socket.socket:
+    """Retry a refused/unreachable connect with exponential backoff and
+    full jitter until `deadline` seconds have elapsed — the peer's
+    receiver may simply not be up yet (hosts boot in any order)."""
+    t_end = time.monotonic() + deadline
+    attempt = 0
+    last_err = None
+    while True:
+        try:
+            # clamp the per-attempt timeout to the remaining deadline so
+            # a blackholed host (SYN dropped, no RST) cannot overshoot
+            # the promised bound by a whole attempt
+            left = max(t_end - time.monotonic(), 0.001)
+            return socket.create_connection((host, port),
+                                            timeout=min(timeout, left))
+        except socket.gaierror:
+            raise   # unresolvable name: a config error, not boot order
+        except socket.timeout as e:
+            last_err = e    # per-attempt timeout: transient by definition
+        except OSError as e:
+            if e.errno not in _TRANSIENT_CONNECT_ERRNOS:
+                raise
+            last_err = e
+        remaining = t_end - time.monotonic()
+        if remaining <= 0:
+            raise ConnectionError(
+                f"row channel connect to {host}:{port} failed for "
+                f"{deadline}s ({attempt + 1} attempts); last error: "
+                f"{last_err}") from last_err
+        # full jitter over an exponentially growing window, capped
+        backoff = random.uniform(0, min(2.0, 0.05 * (2 ** attempt)))
+        time.sleep(min(backoff, remaining))
+        attempt += 1
+
+
+class RowSender:
+    """Client end: ships structured-array batches to a RowReceiver.
+
+    ``connect_deadline`` (seconds) opts into connect retry with backoff;
+    ``heartbeat`` (seconds) opts into idle-link liveness frames.  Both
+    default to off = the original single-attempt, silent-link protocol.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 connect_deadline: float = None, heartbeat: float = None):
+        if connect_deadline is None:
+            self._sock = socket.create_connection((host, port),
+                                                  timeout=timeout)
+        else:
+            self._sock = _connect_with_backoff(host, port, timeout,
+                                               float(connect_deadline))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._dtype_sent = None
+        self._send_lock = threading.Lock()
+        self._last_send = time.monotonic()
+        #: set to the underlying OSError when close() could not deliver
+        #: EOS (peer already dead) — the shutdown was NOT clean
+        self.failed = None
+        self._hb_error = None
+        self._hb_stop = None
+        self._hb_thread = None
+        if heartbeat is not None:
+            self._hb_stop = threading.Event()
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, args=(float(heartbeat),),
+                daemon=True, name="wf-rowsend-hb")
+            self._hb_thread.start()
+
+    # -- liveness ----------------------------------------------------------
+
+    def _hb_loop(self, interval: float):
+        while not self._hb_stop.wait(interval):
+            try:
+                # passive death probe: the receiver never sends data, so
+                # any EOF/RST here means the peer is gone — surface it
+                # now instead of at the next (possibly far-away) send.
+                # (A plain recv would honor the socket timeout and block
+                # the beat; select(0) keeps the probe non-blocking.)
+                try:
+                    readable, _, _ = select.select([self._sock], [], [], 0)
+                except ValueError:
+                    # fd beyond select's FD_SETSIZE (huge-process case):
+                    # skip the probe, the beat itself must still go out
+                    readable = []
+                if readable and self._sock.recv(4096) == b"":
+                    raise ConnectionError(
+                        "row channel peer closed the connection")
+                with self._send_lock:
+                    if time.monotonic() - self._last_send >= interval:
+                        self._sock.sendall(_LEN.pack(_HEARTBEAT_FRAME))
+                        self._last_send = time.monotonic()
+            except OSError as e:
+                self._hb_error = e
+                return
+
+    def _check_alive(self):
+        if self._hb_error is not None:
+            raise ChannelError(
+                f"row channel peer dead (heartbeat): {self._hb_error}"
+            ) from self._hb_error
+
+    def _stop_heartbeat(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=5.0)
+
+    # -- data path ---------------------------------------------------------
 
     def send(self, batch: np.ndarray):
         if len(batch) == 0:
             return
-        if self._dtype_sent is None:
-            d = _encode_dtype(batch.dtype)
-            self._sock.sendall(_LEN.pack(len(d)) + d)
-            self._dtype_sent = batch.dtype
-        elif batch.dtype != self._dtype_sent:
-            raise TypeError(
-                f"row channel dtype changed mid-stream: {self._dtype_sent}"
-                f" -> {batch.dtype}")
-        payload = np.ascontiguousarray(batch).tobytes()
-        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        self._check_alive()
+        with self._send_lock:
+            if self._dtype_sent is None:
+                d = _encode_dtype(batch.dtype)
+                self._sock.sendall(_LEN.pack(len(d)) + d)
+                self._dtype_sent = batch.dtype
+            elif batch.dtype != self._dtype_sent:
+                raise TypeError(
+                    f"row channel dtype changed mid-stream: "
+                    f"{self._dtype_sent} -> {batch.dtype}")
+            payload = np.ascontiguousarray(batch).tobytes()
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+            self._last_send = time.monotonic()
 
     def close(self):
-        """Signal EOS (empty frame) and close the socket."""
+        """Signal EOS (empty frame) and close the socket.  If the EOS
+        frame cannot be delivered (peer already dead) the failure is
+        SURFACED — ``self.failed`` is set and :class:`ChannelError`
+        raised — never reported as a clean shutdown: the peer may have
+        consumed a truncated stream."""
+        self._stop_heartbeat()
+        err = self._hb_error
         try:
-            if self._dtype_sent is None:
-                # dtype never sent: ship a placeholder so the receiver's
-                # framing stays uniform (empty dtype, then EOS)
-                d = _encode_dtype(None)
-                self._sock.sendall(_LEN.pack(len(d)) + d)
-            self._sock.sendall(_LEN.pack(-1))
+            if err is None:
+                with self._send_lock:
+                    if self._dtype_sent is None:
+                        # dtype never sent: ship a placeholder so the
+                        # receiver's framing stays uniform (empty dtype,
+                        # then EOS)
+                        d = _encode_dtype(None)
+                        self._sock.sendall(_LEN.pack(len(d)) + d)
+                    self._sock.sendall(_LEN.pack(_EOS_FRAME))
+        except OSError as e:
+            err = e
+        finally:
+            self._sock.close()
+        if err is not None:
+            self.failed = err
+            raise ChannelError(
+                f"RowSender.close: EOS frame not delivered — peer dead "
+                f"before clean shutdown (receiver may see a truncated "
+                f"stream): {err}") from err
+
+    def abort(self):
+        """Failure-path close: best-effort ABORT frame (length ``-3``) so
+        the receiver fails fast with :class:`PeerAbort` instead of
+        hanging or mistaking the death for a clean EOS.  Never raises —
+        it is called from error paths that must not mask the original
+        failure."""
+        self._stop_heartbeat()
+        try:
+            with self._send_lock:
+                self._sock.sendall(_LEN.pack(_ABORT_FRAME))
+        except OSError:
+            pass    # peer already gone: its reader fails on EOF instead
         finally:
             self._sock.close()
 
 
 class RowReceiver:
     """Server end: accepts ``n_senders`` connections and yields their
-    batches until every sender closes."""
+    batches until every sender closes.
+
+    ``stall_timeout`` (seconds) bounds how long a reader may wait on a
+    silent socket: a peer that stalls mid-frame or stops heartbeating
+    surfaces as :class:`PeerStall` from ``batches()`` instead of hanging
+    the pipeline forever.  Size it to several sender heartbeat intervals
+    (``WireConfig.hardened()`` uses 5x).  Default off = original
+    wait-forever behavior."""
 
     def __init__(self, n_senders: int, host: str = "127.0.0.1",
-                 port: int = 0, capacity: int = 64):
+                 port: int = 0, capacity: int = 64,
+                 stall_timeout: float = None, accept_timeout: float = None):
         self.n_senders = int(n_senders)
+        self.stall_timeout = stall_timeout
+        #: bound on the ACCEPT phase: how long to wait for all senders to
+        #: connect at all.  Size it to the deployment's boot-order budget
+        #: (the senders' connect_deadline), NOT to stall_timeout — hosts
+        #: legitimately boot much slower than a live link may stall.
+        self.accept_timeout = accept_timeout
         self._srv = socket.create_server((host, port),
                                          backlog=self.n_senders)
         self.host, self.port = self._srv.getsockname()[:2]
         self._q = queue.Queue(maxsize=capacity)
+        self._conns: list[socket.socket] = []
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True,
                                                name="wf-rowrecv-accept")
@@ -136,29 +363,82 @@ class RowReceiver:
 
     def _accept_loop(self):
         readers = []
+        accepted = 0
+        failure = None
+        accept_end = (time.monotonic() + float(self.accept_timeout)
+                      if self.accept_timeout is not None else None)
         try:
             for _ in range(self.n_senders):
+                if accept_end is not None:
+                    # a TOTAL window over all senders: each accept gets
+                    # the remaining budget, not a fresh per-peer clock
+                    self._srv.settimeout(
+                        max(accept_end - time.monotonic(), 0.001))
                 conn, _addr = self._srv.accept()
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if self.stall_timeout is not None:
+                    conn.settimeout(float(self.stall_timeout))
+                self._conns.append(conn)
                 t = threading.Thread(target=self._read_loop, args=(conn,),
                                      daemon=True, name="wf-rowrecv")
                 t.start()
                 readers.append(t)
+                accepted += 1
+        except socket.timeout:
+            failure = PeerStall(
+                f"only {accepted}/{self.n_senders} senders connected "
+                f"within the {self.accept_timeout}s accept window")
         except OSError:
-            pass  # server closed while accepting: senders never came
+            # server closed while accepting (receiver torn down / failure
+            # path): the senders that never connected must surface as an
+            # error, not leave batches() blocked forever
+            failure = ChannelError(
+                f"row channel receiver closed with only {accepted}/"
+                f"{self.n_senders} senders connected")
         finally:
             self._srv.close()
+            if failure is not None:
+                # one error + one done-marker per missing sender keeps
+                # the batches() accounting exact and wakes it NOW
+                for _ in range(self.n_senders - accepted):
+                    self._q.put(failure)
+                    self._q.put(None)
+
+    def _next_frame(self, conn: socket.socket):
+        """One payload frame (bytes), or None on clean EOS.  Heartbeat
+        frames are consumed silently; an ABORT frame raises."""
+        while True:
+            n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+            if n >= 0:
+                return _read_exact(conn, n)
+            if n == _EOS_FRAME:
+                return None
+            if n == _HEARTBEAT_FRAME:
+                continue
+            if n == _ABORT_FRAME:
+                raise PeerAbort(
+                    "row channel sender ABORTED mid-stream (its process "
+                    "failed): data received so far is a truncated prefix, "
+                    "not a complete stream")
+            raise ChannelError(f"bad row-channel frame length {n}")
 
     def _read_loop(self, conn: socket.socket):
         try:
-            n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
-            dtype = _decode_dtype(_read_exact(conn, n))
-            while True:
-                n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
-                if n < 0:
-                    break
-                raw = _read_exact(conn, n)
-                self._q.put(np.frombuffer(raw, dtype=dtype).copy())
+            raw = self._next_frame(conn)
+            if raw is not None:
+                dtype = _decode_dtype(raw)
+                while True:
+                    raw = self._next_frame(conn)
+                    if raw is None:
+                        break
+                    self._q.put(np.frombuffer(raw, dtype=dtype).copy())
+        except socket.timeout as e:
+            stall = PeerStall(
+                f"row channel peer silent for {self.stall_timeout}s "
+                f"(no data or heartbeat): stalled mid-stream or "
+                f"partitioned")
+            stall.__cause__ = e
+            self._q.put(stall)
         except Exception as e:  # noqa: BLE001 — ANY reader failure (IO,
             # undecodable dtype from a version-mismatched peer, bad frame)
             # must surface in batches(); the finally's None alone would
@@ -172,7 +452,10 @@ class RowReceiver:
     def batches(self):
         """Yield batches until every sender has sent EOS; raises if any
         connection died mid-stream (fail fast — a silently truncated
-        stream would produce silently wrong window totals)."""
+        stream would produce silently wrong window totals).  When the
+        feeding source node of a Dataflow iterates this, a raised peer
+        failure lands in ``Dataflow._errors`` and ``wait()`` re-raises
+        it — remote death is a graph error, not a hang."""
         done = 0
         while done < self.n_senders:
             item = self._q.get()
@@ -182,6 +465,25 @@ class RowReceiver:
                 raise item
             else:
                 yield item
+
+    def close(self):
+        """Tear the receiver down (failure path / tests): close the
+        listening socket and every accepted connection.  Live senders
+        see a reset on their next send, and a consumer blocked in
+        batches() during the accept phase is woken with a classified
+        error — fail fast, not hang."""
+        try:
+            # closing an fd does NOT wake a thread blocked in accept();
+            # shutdown() does (Linux: accept returns EINVAL)
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 def partition_and_ship(batch: np.ndarray, owners: np.ndarray, my_pid: int,

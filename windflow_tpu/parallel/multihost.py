@@ -125,6 +125,59 @@ def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
         routing(np.asarray(keys, dtype=np.int64), n_kf), dtype=np.int64)]
 
 
+def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
+                   wire=None):
+    """Build the full cross-host row data plane for a process: one
+    :class:`~windflow_tpu.parallel.channel.RowReceiver` listening at
+    ``addresses[my_pid]`` and one hardened
+    :class:`~windflow_tpu.parallel.channel.RowSender` per remote process,
+    returned as ``(receiver, {pid: sender})`` — the handles
+    ``partition_and_ship`` wants.
+
+    ``addresses`` maps process id -> ``(host, port)`` for every process
+    in the job (the deployment's static wiring, typically derived from
+    the coordinator address + a port base).  ``wire`` is a
+    :class:`~windflow_tpu.parallel.channel.WireConfig`; the default is
+    ``WireConfig.hardened()`` — unlike the raw channel classes (whose
+    bare defaults stay seed-identical), a *plane* built through this
+    helper gets retries, heartbeats and stall timeouts out of the box,
+    because hosts boot in arbitrary order and a production job must
+    degrade loudly, not hang, when a peer dies (docs/ROBUSTNESS.md).
+    Connect order is safe in any boot order: the receiver is bound
+    before any outbound connect, and connects retry with backoff until
+    the wire deadline."""
+    from .channel import RowReceiver, RowSender, WireConfig
+    if my_pid not in addresses:
+        raise KeyError(f"addresses has no entry for this process "
+                       f"(pid {my_pid}): {sorted(addresses)}")
+    if wire is None:
+        wire = WireConfig.hardened()
+    host, port = addresses[my_pid]
+    receiver = RowReceiver(n_senders=len(addresses) - 1, host=host,
+                           port=port, capacity=capacity,
+                           stall_timeout=wire.stall_timeout,
+                           # a peer that dies before ever connecting must
+                           # surface within the boot-order budget, not
+                           # hang batches() forever
+                           accept_timeout=wire.connect_deadline)
+    senders = {}
+    try:
+        for pid in sorted(addresses):
+            if pid == my_pid:
+                continue
+            peer_host, peer_port = addresses[pid]
+            senders[pid] = RowSender(
+                peer_host, peer_port, timeout=wire.connect_timeout,
+                connect_deadline=wire.connect_deadline,
+                heartbeat=wire.heartbeat)
+    except Exception:
+        for snd in senders.values():
+            snd.abort()
+        receiver.close()
+        raise
+    return receiver, senders
+
+
 def local_kf_groups(mesh: Mesh, process_index=None,
                     process_of=None) -> np.ndarray:
     """The kf-group indices whose device rows live on this process."""

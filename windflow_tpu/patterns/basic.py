@@ -178,6 +178,7 @@ class Source(_Pattern):
 # ----------------------------------------------------------------------- Map
 
 class _MapNode(Node):
+    shed_safe = True   # stateless operator: shedding drops stream rows
     #: always true: emits either its private copy, a fresh out-schema
     #: array, or (elided path) an input batch that was itself handed off
     yields_fresh = True
@@ -240,6 +241,7 @@ class Map(_Pattern):
 # -------------------------------------------------------------------- Filter
 
 class _FilterNode(Node):
+    shed_safe = True   # stateless operator: shedding drops stream rows
     #: the surviving-rows gather is a fresh allocation every time
     yields_fresh = True
 
@@ -283,6 +285,8 @@ class Filter(_Pattern):
 # ------------------------------------------------------------------- FlatMap
 
 class _FlatMapNode(Node):
+    shed_safe = True   # stateless operator: shedding drops stream rows
+
     def __init__(self, fn, name, rich, vectorized, out_schema, chunk):
         super().__init__(name)
         self.fn = fn
@@ -332,6 +336,8 @@ class FlatMap(_Pattern):
 # --------------------------------------------------------------- Accumulator
 
 class _AccumulatorNode(Node):
+    shed_safe = True   # keyed fold: shedding drops rows, no dense-id need
+
     def __init__(self, fn, init_value, result_schema, name, rich,
                  vectorized=False):
         super().__init__(name)
@@ -411,6 +417,8 @@ class Accumulator(_Pattern):
 # ---------------------------------------------------------------------- Sink
 
 class _SinkNode(Node):
+    shed_safe = True   # terminal: shedding drops deliveries only
+
     def __init__(self, fn, name, rich, vectorized):
         super().__init__(name)
         self.fn = fn

@@ -30,6 +30,9 @@ _NEG_INF = np.int64(-(2 ** 62))
 class WFEmitterNode(Node):
     """Window-range multicast emitter (wf_nodes.hpp:40-195)."""
 
+    quarantine_exempt = True    # framework shell: errors here fail fast
+    shed_safe = True            # farm head: shedding drops raw stream rows
+
     def __init__(self, spec: WindowSpec, pardegree: int, id_outer=0, n_outer=1,
                  slide_outer=None, role: Role = Role.SEQ, name="wf_emitter"):
         super().__init__(name)
@@ -112,6 +115,8 @@ class WFCollectorNode(Node):
     prefix test over a (key, id) lexsort, and each svc emits at most ONE
     batch (per-key tiny emits would turn 10^5 keys into 10^5 downstream
     svc calls)."""
+
+    quarantine_exempt = True    # framework shell: errors here fail fast
 
     def __init__(self, name="wf_collector"):
         super().__init__(name)

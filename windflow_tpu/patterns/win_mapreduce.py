@@ -43,6 +43,9 @@ _NEG_INF = np.int64(-(2 ** 62))
 class WinMapEmitterNode(Node):
     """Per-key round-robin partitioner (wm_nodes.hpp:40-133)."""
 
+    quarantine_exempt = True    # framework shell: errors here fail fast
+    shed_safe = True            # farm head: shedding drops raw stream rows
+
     def __init__(self, map_degree: int, win_type: WinType, name="wm_emitter"):
         super().__init__(name)
         self.map_degree = map_degree

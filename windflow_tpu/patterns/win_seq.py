@@ -14,6 +14,12 @@ from .basic import _Pattern
 class WinSeqNode(Node):
     """Runtime node driving a WinSeqCore."""
 
+    #: svc folds rows into per-key window/ordering state BEFORE any
+    #: raise, so a quarantined batch would leave that state partially
+    #: mutated (silently wrong windows) — never quarantine under the
+    #: dataflow-wide error_budget; fail fast (runtime/overload.py)
+    quarantine_exempt = True
+
     def __init__(self, core: WinSeqCore, name="win_seq"):
         super().__init__(name)
         self.core = core

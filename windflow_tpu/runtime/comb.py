@@ -52,6 +52,21 @@ class Comb(Node):
             b.input_fresh = bool(a.yields_fresh)
         #: the Comb hands downstream whatever its last stage emits
         self.yields_fresh = bool(self.stages[-1].yields_fresh)
+        #: the Comb's inbox feeds its FIRST stage, so the overload
+        #: contract of that stage governs the fused node (shed only if
+        #: the head may shed, runtime/overload.py)
+        self.shed_safe = bool(getattr(self.stages[0], "shed_safe", False))
+        #: if ANY fused stage is a framework shell or stateful window
+        #: core, an error mid-chain cannot be attributed to a cleanly
+        #: un-processed batch — the fused node inherits fail-fast
+        self.quarantine_exempt = any(
+            getattr(s, "quarantine_exempt", False) for s in self.stages)
+        #: an explicitly configured member budget still governs the chain
+        #: (tightest wins; one svc error parks the chain's input batch)
+        budgets = [s.error_budget for s in self.stages
+                   if getattr(s, "error_budget", None) is not None]
+        if budgets:
+            self.error_budget = min(budgets)
 
     # -- lifecycle ---------------------------------------------------------
 

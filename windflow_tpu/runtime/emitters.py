@@ -233,6 +233,9 @@ class StandardEmitter(Node):
     """Pass-through (n=1), block round-robin, or keyed routing emitter
     (standard.hpp:40-88)."""
 
+    quarantine_exempt = True    # framework shell: errors here fail fast
+    shed_safe = True            # farm head: shedding drops raw stream rows
+
     def __init__(self, n_dest: int, routing=None, name="emitter"):
         super().__init__(name)
         self.n_dest = n_dest
@@ -261,6 +264,8 @@ class StandardEmitter(Node):
 
 class Collector(Node):
     """Trivial multi-in merge (standard.hpp:91-94)."""
+
+    quarantine_exempt = True    # framework shell: errors here fail fast
 
     def __init__(self, name="collector"):
         super().__init__(name)

@@ -18,9 +18,12 @@ from __future__ import annotations
 
 import queue
 import threading
+from time import monotonic as _monotonic
 from time import perf_counter_ns as _pc_ns
+from time import sleep as _sleep
 
 from .node import Node, RuntimeContext, SourceNode
+from .overload import DeadLetter, OverloadError, OverloadPolicy
 
 _EOS = object()
 
@@ -34,12 +37,24 @@ class Inbox:
     """MPSC channel carrying (src_slot, batch) pairs.  Blocking operations
     poll the dataflow's failure flag so a raised node cannot deadlock the
     graph (a full queue whose consumer died would block producers
-    forever)."""
+    forever).
 
-    def __init__(self, capacity: int = 0, failed: threading.Event = None):
+    An :class:`~windflow_tpu.runtime.overload.OverloadPolicy` reshapes the
+    ``put`` side only (shed_oldest / shed_newest / deadline-bounded block);
+    ``put_eos`` and ``get`` are policy-exempt — an EOS that is shed or
+    timed out would corrupt the per-channel EOS counting.  Shed items are
+    counted in ``self.shed`` (surfaced per node via tracing.NodeStats and
+    ``Dataflow.shed_counts``)."""
+
+    def __init__(self, capacity: int = 0, failed: threading.Event = None,
+                 policy: OverloadPolicy = None):
         self._q = queue.Queue(maxsize=capacity)
         self.n_sources = 0
         self._failed = failed
+        self._policy = policy if (policy is not None
+                                  and policy.reshapes_put) else None
+        self.shed = 0
+        self._shed_lock = threading.Lock()
 
     def register_source(self) -> int:
         slot = self.n_sources
@@ -54,8 +69,70 @@ class Inbox:
                 if self._failed is not None and self._failed.is_set():
                     raise _Cancelled() from None
 
+    def _record_shed(self):
+        with self._shed_lock:
+            self.shed += 1
+
+    def _cancelled(self) -> bool:
+        return self._failed is not None and self._failed.is_set()
+
     def put(self, src: int, item):
-        self._blocking(lambda: self._q.put((src, item), timeout=0.05))
+        pol = self._policy
+        if pol is None:
+            self._blocking(lambda: self._q.put((src, item), timeout=0.05))
+        elif pol.shed == "shed_newest":
+            try:
+                self._q.put_nowait((src, item))
+            except queue.Full:
+                if self._cancelled():
+                    # shed_newest never blocks, so this is the only spot
+                    # a producer can observe a failed graph — without it
+                    # an unbounded source would generate forever
+                    raise _Cancelled() from None
+                self._record_shed()
+        elif pol.shed == "shed_oldest":
+            self._put_shed_oldest(src, item)
+        else:  # block with a deadline
+            self._put_deadline(src, item, pol.put_deadline)
+
+    def _put_shed_oldest(self, src: int, item):
+        while True:
+            try:
+                return self._q.put_nowait((src, item))
+            except queue.Full:
+                if self._cancelled():
+                    raise _Cancelled() from None
+            # evict the head to admit the new item.  EOS frames must
+            # survive: re-queue them at the tail (safe — EOS is its
+            # channel's LAST frame, so per-channel order is preserved)
+            try:
+                victim = self._q.get_nowait()
+            except queue.Empty:
+                continue    # consumer drained it meanwhile; retry the put
+            if victim[1] is _EOS:
+                self._blocking(
+                    lambda: self._q.put(victim, timeout=0.05))
+                # shutdown skew: a full queue of only EOS frames would
+                # otherwise hot-spin evict/re-queue until the (slow —
+                # that's why shedding is on) consumer drains one
+                _sleep(0.001)
+            else:
+                self._record_shed()
+
+    def _put_deadline(self, src: int, item, deadline: float):
+        t_end = _monotonic() + deadline
+        while True:
+            try:
+                return self._q.put((src, item), timeout=0.05)
+            except queue.Full:
+                if self._cancelled():
+                    raise _Cancelled() from None
+                if _monotonic() >= t_end:
+                    raise OverloadError(
+                        f"inbox put blocked longer than the "
+                        f"{deadline}s deadline (capacity "
+                        f"{self._q.maxsize}): downstream stage is not "
+                        f"keeping up") from None
 
     def put_eos(self, src: int):
         self._blocking(lambda: self._q.put((src, _EOS), timeout=0.05))
@@ -76,13 +153,17 @@ class NativeInbox:
     (the payload-pointer discipline of FastFlow's SPSC queues)."""
 
     def __init__(self, capacity: int, failed: threading.Event = None,
-                 lib=None):
+                 lib=None, policy: OverloadPolicy = None):
         self._lib = lib
         self._h = lib.wf_queue_new(capacity)
         self._items = {}
         self._seq = 0
         self._seq_lock = threading.Lock()
         self.n_sources = 0
+        self._policy = policy if (policy is not None
+                                  and policy.reshapes_put) else None
+        self.shed = 0
+        self._shed_lock = threading.Lock()
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -97,17 +178,78 @@ class NativeInbox:
         self.n_sources += 1
         return slot
 
-    def _push(self, src: int, item):
+    def _slot_for(self, item) -> int:
         with self._seq_lock:
             self._seq += 1
             slot = self._seq
         self._items[slot] = item
+        return slot
+
+    def _push(self, src: int, item):
+        slot = self._slot_for(item)
         if self._lib.wf_queue_push(self._h, src, slot) != 0:
             self._items.pop(slot, None)
             raise _Cancelled()
 
+    def _record_shed(self):
+        with self._shed_lock:
+            self.shed += 1
+
     def put(self, src: int, item):
-        self._push(src, item)
+        pol = self._policy
+        if pol is None:
+            return self._push(src, item)
+        slot = self._slot_for(item)
+        if pol.shed == "shed_newest":
+            rc = self._lib.wf_queue_try_push(self._h, src, slot)
+            if rc == 0:
+                return
+            self._items.pop(slot, None)
+            if rc < 0:
+                raise _Cancelled()
+            self._record_shed()
+        elif pol.shed == "shed_oldest":
+            self._put_shed_oldest(src, slot)
+        else:  # block with a deadline
+            rc = self._lib.wf_queue_push_timed(
+                self._h, src, slot, int(pol.put_deadline * 1000))
+            if rc == 0:
+                return
+            self._items.pop(slot, None)
+            if rc < 0:
+                raise _Cancelled()
+            raise OverloadError(
+                f"inbox put blocked longer than the {pol.put_deadline}s "
+                f"deadline (native ring): downstream stage is not "
+                f"keeping up")
+
+    def _put_shed_oldest(self, src: int, slot: int):
+        import ctypes
+        lib = self._lib
+        vsrc = ctypes.c_longlong()
+        vslot = ctypes.c_longlong()
+        while True:
+            rc = lib.wf_queue_try_push(self._h, src, slot)
+            if rc == 0:
+                return
+            if rc < 0:
+                self._items.pop(slot, None)
+                raise _Cancelled()
+            # full: evict the head to admit the new item (EOS survives —
+            # re-queued at the tail, see Inbox._put_shed_oldest)
+            rc2 = lib.wf_queue_try_pop(self._h, ctypes.byref(vsrc),
+                                       ctypes.byref(vslot))
+            if rc2 < 0:
+                self._items.pop(slot, None)
+                raise _Cancelled()
+            if rc2 == 1:
+                continue    # consumer drained it meanwhile; retry the push
+            victim = self._items.pop(vslot.value)
+            if victim is _EOS:
+                self._push(vsrc.value, victim)
+                _sleep(0.001)   # see Inbox._put_shed_oldest: no hot spin
+            else:
+                self._record_shed()
 
     def put_eos(self, src: int):
         self._push(src, _EOS)
@@ -125,13 +267,19 @@ class NativeInbox:
         self._lib.wf_queue_close(self._h)
 
 
-def _make_inbox(capacity: int, failed: threading.Event):
+def _make_inbox(capacity: int, failed: threading.Event,
+                policy: OverloadPolicy = None):
     if capacity > 0:  # capacity 0 = unbounded, which only the Python
         from ..native import enabled  # queue implements
         lib = enabled()
-        if lib is not None:
-            return NativeInbox(capacity, failed, lib=lib)
-    return Inbox(capacity, failed)
+        if lib is not None and (
+                policy is None or not policy.reshapes_put
+                or getattr(lib, "wf_has_overload_queue", False)):
+            # an old .so without the overload entry points still serves
+            # every default path; only active shed/deadline knobs fall
+            # back to the Python queue
+            return NativeInbox(capacity, failed, lib=lib, policy=policy)
+    return Inbox(capacity, failed, policy)
 
 
 class Dataflow:
@@ -140,27 +288,59 @@ class Dataflow:
     multipipe.hpp:1010; same model here)."""
 
     def __init__(self, name: str = "dataflow", capacity: int = 16,
-                 trace_dir: str = None):
+                 trace_dir: str = None, overload: OverloadPolicy = None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
         # proportional to capacity x batch size.  0 = unbounded.
+        # `overload` (runtime/overload.py) opts the graph into shedding /
+        # put deadlines / poison-tuple quarantine; None = seed behavior.
         from ..utils.tracing import default_trace_dir
+        if overload is not None and overload.reshapes_put and capacity <= 0:
+            # an unbounded queue never fills: every shed/deadline knob
+            # would be silently inert while memory grows without bound
+            raise ValueError(
+                f"OverloadPolicy with shed={overload.shed!r}/"
+                f"put_deadline={overload.put_deadline} needs a bounded "
+                f"inbox (capacity > 0, got {capacity}): an unbounded "
+                f"queue never sheds and never times out")
         self.name = name
         self.capacity = capacity
         self.trace_dir = trace_dir or default_trace_dir()
+        self.overload = overload
         self.nodes: list[Node] = []
         self._inboxes: dict[int, Inbox] = {}
         self._edges: list[tuple[Node, Node]] = []
         self._threads: list[threading.Thread] = []
         self._errors: list[BaseException] = []
         self._failed = threading.Event()
+        #: quarantined poison batches (DeadLetter records, arrival order);
+        #: inspect after wait() — only ever populated when an error budget
+        #: is set (overload.error_budget or a node/pattern-level budget)
+        self.dead_letters: list[DeadLetter] = []
+        self._dead_lock = threading.Lock()
+
+    def _inbox_policy(self, node: Node) -> OverloadPolicy:
+        """Shedding applies only at shed-safe inboxes (farm heads and
+        stateless operators — dropping there means dropping raw stream
+        items).  Internal farm edges (window multicast copies, dense-id
+        result streams, ordering merges) keep blocking, so overload
+        backpressures through them to the nearest shed-safe inbox
+        upstream instead of silently corrupting window state.  A put
+        deadline (block policy) is loud, not lossy, so it applies
+        everywhere."""
+        pol = self.overload
+        if (pol is not None and pol.shed != "block"
+                and not getattr(node, "shed_safe", False)):
+            return None
+        return pol
 
     def add(self, node: Node, ctx: RuntimeContext = None) -> Node:
         if ctx is not None:
             node.ctx = ctx
         self.nodes.append(node)
-        self._inboxes[id(node)] = _make_inbox(self.capacity, self._failed)
+        self._inboxes[id(node)] = _make_inbox(self.capacity, self._failed,
+                                              self._inbox_policy(node))
         return node
 
     def connect(self, src: Node, dst: Node):
@@ -172,6 +352,29 @@ class Dataflow:
         self._edges.append((src, dst))
 
     # ------------------------------------------------------------------ run
+
+    def _error_budget_of(self, node: Node) -> int:
+        """Effective poison-tuple allowance: node-level override first
+        (builders' withErrorBudget / a pattern's error_budget, propagated
+        onto replicas by runtime/farm.py), then the dataflow policy —
+        except for quarantine-exempt framework shells (emitters,
+        collectors, ordering merges), which never inherit the policy
+        default: an error there is a framework bug, not a poison tuple."""
+        budget = getattr(node, "error_budget", None)
+        if budget is None:
+            if getattr(node, "quarantine_exempt", False):
+                return 0
+            budget = (self.overload.error_budget
+                      if self.overload is not None else 0)
+        return int(budget)
+
+    def _quarantine(self, node: Node, batch, channel: int,
+                    error: BaseException):
+        with self._dead_lock:
+            self.dead_letters.append(
+                DeadLetter(node.name, batch, channel, error))
+        if node.stats is not None:
+            node.stats.record_quarantined()
 
     def _run_node(self, node: Node):
         try:
@@ -188,11 +391,32 @@ class Dataflow:
                 inbox = self._inboxes[id(node)]
                 live = inbox.n_sources
                 stats = node.stats
+                budget = self._error_budget_of(node)
                 while live > 0:
                     src, item = inbox.get()
                     if item is _EOS:
                         live -= 1
                         node.on_channel_eos(src)
+                    elif budget > 0:
+                        # poison-tuple quarantine: an svc error within
+                        # budget parks the batch in the dead-letter queue
+                        # and the node lives on; once the budget is spent
+                        # the next error fails fast exactly like default
+                        try:
+                            if stats is None:
+                                node.svc(item, src)
+                            else:
+                                t0 = _pc_ns()
+                                node.svc(item, src)
+                                stats.record_svc(len(item), _pc_ns() - t0)
+                        except OverloadError:
+                            # a put deadline expiring inside svc's emit is
+                            # backpressure failure, not a poison tuple —
+                            # it must fail fast, not burn the budget
+                            raise
+                        except Exception as e:  # _Cancelled passes through
+                            budget -= 1
+                            self._quarantine(node, item, src, e)
                     elif stats is None:
                         node.svc(item, src)
                     else:
@@ -202,6 +426,9 @@ class Dataflow:
             node.eosnotify()
             node.svc_end()
             if node.stats is not None:
+                shed = getattr(self._inboxes[id(node)], "shed", 0)
+                if shed:
+                    node.stats.record_shed(shed)
                 node.stats.write(self.trace_dir)
         except _Cancelled:
             pass  # the graph failed elsewhere; exit quietly
@@ -240,3 +467,14 @@ class Dataflow:
     def cardinality(self) -> int:
         """Number of execution threads (multipipe.hpp:973)."""
         return len(self.nodes)
+
+    def shed_counts(self) -> dict[str, int]:
+        """Items shed per node (the node whose inbox dropped them), for
+        graphs running a shedding OverloadPolicy; empty under the default
+        blocking policy.  Stable once wait() returned."""
+        out: dict[str, int] = {}
+        for node in self.nodes:
+            shed = getattr(self._inboxes[id(node)], "shed", 0)
+            if shed:
+                out[node.name] = out.get(node.name, 0) + shed
+        return out

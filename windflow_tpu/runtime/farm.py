@@ -14,6 +14,18 @@ from .node import Node
 DEFAULT = object()
 
 
+def _apply_error_budget(pattern, replicas: list[Node]) -> list[Node]:
+    """Propagate a pattern-level poison-tuple budget (builders'
+    withErrorBudget) onto the worker nodes the engine actually runs —
+    shell nodes (emitter/collector) keep fail-fast semantics: an error
+    there is a framework bug, not a poison tuple."""
+    budget = getattr(pattern, "error_budget", None)
+    if budget is not None:
+        for r in replicas:
+            r.error_budget = int(budget)
+    return replicas
+
+
 def add_farm(df: Dataflow, pattern, upstreams: list[Node],
              emitter: Node = DEFAULT, collector: Node = DEFAULT) -> list[Node]:
     """Instantiate `pattern` as emitter -> replicas -> collector, feeding it
@@ -39,7 +51,7 @@ def add_farm(df: Dataflow, pattern, upstreams: list[Node],
             raise ValueError(
                 f"{pattern.name}: n_emitters={n_emitters} needs exactly "
                 f"that many upstream producers, got {len(upstreams)}")
-        replicas = pattern.replicas()
+        replicas = _apply_error_budget(pattern, pattern.replicas())
         for r in replicas:
             df.add(r)
         for up in upstreams:
@@ -56,7 +68,7 @@ def add_farm(df: Dataflow, pattern, upstreams: list[Node],
                 df.connect(r, collector)
             return [collector]
         return replicas
-    replicas = pattern.replicas()
+    replicas = _apply_error_budget(pattern, pattern.replicas())
     for r in replicas:
         df.add(r)
     if emitter is DEFAULT:
@@ -130,7 +142,7 @@ def fuse_two_stage(df: Dataflow, stage1, stage2, upstreams: list[Node],
 
     if level >= 2:
         # ---- stage 1 workers, each with a fused stage-2 emitter clone ----
-        s1_workers = stage1.replicas()
+        s1_workers = _apply_error_budget(stage1, stage1.replicas())
         need_emitter = (W > 1
                         and not _is_passthrough_emitter(stage2.emitter()))
         combs = []
@@ -158,7 +170,7 @@ def fuse_two_stage(df: Dataflow, stage1, stage2, upstreams: list[Node],
         if isinstance(stage2, WinFarm):
             stage2.n_emitters = P   # replicas become _OrderedWorkerNodes
             stage2.ordering_per_key = True
-            s2_workers = stage2.replicas()
+            s2_workers = _apply_error_budget(stage2, stage2.replicas())
         else:  # degree-1 sequential stage
             mode = (OrderingMode.ID
                     if stage2.spec.win_type is WinType.CB else OrderingMode.TS)

@@ -56,6 +56,27 @@ class Node:
     yields_fresh = False
     #: the wiring layer proved this node's input batches are handed off
     input_fresh = False
+    #: per-node poison-tuple allowance (runtime/overload.py): how many svc
+    #: exceptions this node may quarantine to the dataflow's dead-letter
+    #: queue before failing fast.  None = defer to the dataflow's
+    #: OverloadPolicy.error_budget (itself 0 = fail fast, the default).
+    #: Set via builders' withErrorBudget / a pattern's error_budget
+    #: (propagated onto replicas by runtime/farm.py).
+    error_budget = None
+    #: framework shell nodes (emitters, collectors, ordering merges) set
+    #: this True: an error there is a framework bug, never a poison
+    #: tuple, so the dataflow-wide error_budget default must NOT
+    #: quarantine it (an explicit node-level error_budget still wins)
+    quarantine_exempt = False
+    #: True on nodes whose inbox may LOAD-SHED under a shedding
+    #: OverloadPolicy: farm heads (routing emitters — dropping there is
+    #: dropping raw stream items, the classic shedding point) and
+    #: stateless operator/sink workers.  False (default) on internal
+    #: farm edges — a shed copy of a window-range multicast or of a
+    #: dense-id result stream would silently corrupt windows, so those
+    #: edges keep blocking and the backpressure propagates to the
+    #: nearest shed-safe inbox upstream.
+    shed_safe = False
 
     def __init__(self, name: str = None):
         self.name = name or type(self).__name__

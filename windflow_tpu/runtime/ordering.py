@@ -332,6 +332,9 @@ class OrderingNode(Node):
     #: outputs are merge gathers, renumbered copies, or (owned elision)
     #: batches that were themselves handed off — fresh either way
     yields_fresh = True
+    #: framework merge, not user code: a dropped batch here would
+    #: silently corrupt the ordered stream — always fail fast
+    quarantine_exempt = True
 
     def __init__(self, n_channels: int, mode: OrderingMode, name="ordering",
                  ordered_input: bool = False, owned_input: bool = False):

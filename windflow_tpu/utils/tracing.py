@@ -65,6 +65,17 @@ class NodeStats:
     def bump(self, counter: str, n: int = 1):
         self.counters[counter] = self.counters.get(counter, 0) + n
 
+    def record_shed(self, n: int = 1):
+        """Items dropped from this node's inbox by a shedding
+        OverloadPolicy (runtime/overload.py) — folded in once at node
+        end by the engine, so the hot path stays counter-free."""
+        self.bump("shed", n)
+
+    def record_quarantined(self, n: int = 1):
+        """Poison batches parked in the dead-letter queue instead of
+        tearing the graph down (error-budget quarantine)."""
+        self.bump("quarantined", n)
+
     # -- reporting ----------------------------------------------------------
 
     def snapshot(self) -> dict:
