@@ -25,9 +25,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def run_case(seed: int, case: int, verbose: bool = False) -> dict:
+def run_case(seed: int, case: int, verbose: bool = False,
+             trace_dir: str = None, sample_period: float = None) -> dict:
     """One randomized soak case; raises AssertionError (with the repro
-    command in the message) on any invariant violation."""
+    command in the message) on any invariant violation.
+
+    ``trace_dir``/``sample_period`` opt the case's Dataflow into the
+    observability layer (docs/OBSERVABILITY.md): the live sampler
+    appends to ``<trace_dir>/metrics.jsonl`` while the case runs, which
+    is how a soak under ``wf_top`` demonstrates in-flight occupancy and
+    shedding.  Both also default from WF_LOG_DIR / WF_SAMPLE_PERIOD."""
     from windflow_tpu.core.tuples import Schema, batch_from_columns
     from windflow_tpu.patterns.basic import Map, Sink, Source
     from windflow_tpu.runtime.engine import Dataflow
@@ -79,7 +86,8 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
     df = Dataflow(f"soak{case}", capacity=capacity,
                   overload=OverloadPolicy(shed=shed,
                                           put_deadline=put_deadline,
-                                          error_budget=budget))
+                                          error_budget=budget),
+                  trace_dir=trace_dir, sample_period=sample_period)
     build_pipeline(df, [
         Source(batches=batches, schema=schema),
         Map(poison_map, name="poison_map", vectorized=True),
@@ -140,10 +148,12 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
                 dead=quarantined, error=repr(err) if err else None)
 
 
-def run_soak(n: int, seed: int, verbose: bool = False) -> dict:
+def run_soak(n: int, seed: int, verbose: bool = False,
+             trace_dir: str = None, sample_period: float = None) -> dict:
     stats = {"cases": 0, "shed_cases": 0, "poison_cases": 0, "errors": 0}
     for case in range(n):
-        r = run_case(seed, case, verbose=verbose)
+        r = run_case(seed, case, verbose=verbose, trace_dir=trace_dir,
+                     sample_period=sample_period)
         stats["cases"] += 1
         stats["shed_cases"] += bool(r["shed"])
         stats["poison_cases"] += bool(r["dead"])
@@ -158,13 +168,23 @@ def main():
     ap.add_argument("--case", type=int, default=None,
                     help="run ONE case standalone (failure repro)")
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--trace-dir", default=None,
+                    help="observability output dir (metrics.jsonl / "
+                         "events.jsonl / per-node logs; also WF_LOG_DIR)")
+    ap.add_argument("--sample-period", type=float, default=None,
+                    help="live sampler period in seconds (also "
+                         "WF_SAMPLE_PERIOD); watch with scripts/wf_top.py")
     args = ap.parse_args()
     if args.case is not None:
-        r = run_case(args.seed, args.case, verbose=True)
+        r = run_case(args.seed, args.case, verbose=True,
+                     trace_dir=args.trace_dir,
+                     sample_period=args.sample_period)
         print(r)
         return
     t0 = time.monotonic()
-    stats = run_soak(args.n, args.seed, verbose=args.verbose)
+    stats = run_soak(args.n, args.seed, verbose=args.verbose,
+                     trace_dir=args.trace_dir,
+                     sample_period=args.sample_period)
     print(f"soak clean: {stats} in {time.monotonic() - t0:.1f}s")
 
 

@@ -1,0 +1,177 @@
+"""wf_top — live terminal view of a running dataflow's telemetry.
+
+Tails the ``metrics.jsonl`` the background sampler writes
+(``Dataflow(sample_period=...)`` / ``WF_SAMPLE_PERIOD``, see
+docs/OBSERVABILITY.md) and renders per-node throughput, inbox occupancy
+and shed/quarantine counters, plus the tail of ``events.jsonl`` — the
+`top(1)` of a WindFlow graph.  Rates are derived from consecutive
+samples, so the view needs two samples to warm up.
+
+    WF_LOG_DIR=/tmp/wf WF_SAMPLE_PERIOD=0.5 python my_job.py &
+    python scripts/wf_top.py /tmp/wf              # follow, 1 s refresh
+    python scripts/wf_top.py /tmp/wf --once       # one frame (CI/tests)
+    python scripts/wf_top.py /tmp/wf --expo       # Prometheus text dump
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_COLS = ("NODE", "DEPTH", "HWM", "BATCH/S", "TUPLES/S", "EWMA_US",
+         "SHED", "QUAR")
+_W = (22, 6, 6, 10, 12, 9, 8, 6)
+
+
+def read_samples(path, offset=0):
+    """Parse sample lines appended since ``offset``; returns
+    (new_samples, new_offset).  A torn final line (writer mid-append) is
+    left for the next read."""
+    samples = []
+    with open(path) as f:
+        f.seek(offset)
+        while True:
+            line = f.readline()
+            if not line:
+                break
+            if not line.endswith("\n"):
+                break   # torn tail: re-read next refresh
+            offset = f.tell()
+            try:
+                samples.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return samples, offset
+
+
+def _rates(cur, prev):
+    """Per-node {(node id): (batches/s, tuples/s)} between two samples."""
+    out = {}
+    if prev is None:
+        return out
+    dt = cur["t"] - prev["t"]
+    if dt <= 0:
+        return out
+    before = {n["id"]: n for n in prev["nodes"]}
+    for n in cur["nodes"]:
+        p = before.get(n["id"])
+        if p is None or "rcv_batches" not in n or "rcv_batches" not in p:
+            continue
+        out[n["id"]] = ((n["rcv_batches"] - p["rcv_batches"]) / dt,
+                        (n["rcv_tuples"] - p["rcv_tuples"]) / dt)
+    return out
+
+
+def render(cur, prev, events=(), clock=time.localtime):
+    """One frame of the view as a string (pure: testable without a tty)."""
+    rates = _rates(cur, prev)
+    head = (f"wf_top  dataflow={cur['dataflow']}  seq={cur['seq']}  "
+            f"t={time.strftime('%H:%M:%S', clock(cur['t']))}  "
+            f"dead_letters={cur.get('dead_letters', 0)}")
+    lines = [head, ""]
+    lines.append("  ".join(c.ljust(w) if i == 0 else c.rjust(w)
+                           for i, (c, w) in enumerate(zip(_COLS, _W))))
+    for n in cur["nodes"]:
+        br, tr = rates.get(n["id"], (None, None))
+        row = (n["node"],
+               str(n["depth"]), str(n["hwm"]),
+               f"{br:.1f}" if br is not None else "-",
+               f"{tr:.0f}" if tr is not None else "-",
+               f"{n['ewma_service_us_per_batch']:.1f}"
+               if "ewma_service_us_per_batch" in n else "-",
+               str(n["shed"]), str(n["quarantined"]))
+        lines.append("  ".join(c.ljust(w) if i == 0 else c.rjust(w)
+                               for i, (c, w) in enumerate(zip(row, _W))))
+    counters = {k: v for k, v in cur.get("counters", {}).items() if v}
+    if counters:
+        lines.append("")
+        lines.append("counters: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(counters.items())))
+    if events:
+        lines.append("")
+        lines.append("recent events:")
+        for e in events:
+            extra = " ".join(f"{k}={v}" for k, v in e.items()
+                             if k not in ("t", "event"))
+            lines.append(
+                f"  {time.strftime('%H:%M:%S', clock(e['t']))} "
+                f"{e['event']:<18} {extra}")
+    return "\n".join(lines)
+
+
+def tail_events(path, n=6):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.endswith("\n"):
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out[-n:]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="trace dir (WF_LOG_DIR) or a "
+                                 "metrics.jsonl file")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in follow mode (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="render the latest frame and exit")
+    ap.add_argument("--expo", action="store_true",
+                    help="print the latest sample as Prometheus text "
+                         "exposition and exit")
+    ap.add_argument("--events", type=int, default=6,
+                    help="event-log tail length (0 disables)")
+    a = ap.parse_args(argv)
+
+    path = a.path
+    if os.path.isdir(path):
+        ev_path = os.path.join(path, "events.jsonl")
+        path = os.path.join(path, "metrics.jsonl")
+    else:
+        ev_path = os.path.join(os.path.dirname(path), "events.jsonl")
+    if not os.path.exists(path):
+        print(f"wf_top: no metrics at {path} (is the job running with "
+              f"sample_period / WF_SAMPLE_PERIOD set?)", file=sys.stderr)
+        return 2
+
+    if a.expo:
+        from windflow_tpu.obs import expo
+        samples, _ = read_samples(path)
+        if not samples:
+            print("wf_top: metrics file has no complete samples yet",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(expo.render_sample(samples[-1]))
+        return 0
+
+    offset = 0
+    prev = cur = None
+    while True:
+        new, offset = read_samples(path, offset)
+        for s in new:
+            prev, cur = cur, s
+        if cur is not None:
+            events = tail_events(ev_path, a.events) if a.events else []
+            frame = render(cur, prev, events)
+            if a.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+        elif a.once:
+            print("wf_top: metrics file has no complete samples yet",
+                  file=sys.stderr)
+            return 2
+        time.sleep(a.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

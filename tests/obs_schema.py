@@ -1,0 +1,100 @@
+"""Shared validator for the observability file schemas
+(docs/OBSERVABILITY.md): every line of ``metrics.jsonl`` and
+``events.jsonl`` must parse and carry the documented fields with the
+documented types.  One definition, imported by the tier-1 smoke test and
+the slow soak slice — the schema the docs promise is the schema the
+tests enforce."""
+
+import json
+
+from windflow_tpu.obs.events import EVENT_KINDS
+
+#: required metrics-sample fields -> accepted types
+SAMPLE_FIELDS = {
+    "t": (float,),
+    "seq": (int,),
+    "dataflow": (str,),
+    "nodes": (list,),
+    "dead_letters": (int,),
+    "counters": (dict,),
+    "gauges": (dict,),
+    "histograms": (dict,),
+}
+
+#: required per-node fields (sampler may add optional NodeStats fields:
+#: rcv_batches, rcv_tuples, ewma/avg_service_us_per_batch)
+NODE_FIELDS = {
+    "node": (str,),
+    "id": (str,),
+    "depth": (int,),
+    "hwm": (int,),
+    "shed": (int,),
+    "quarantined": (int,),
+}
+
+NODE_OPTIONAL_FIELDS = {
+    "rcv_batches": (int,),
+    "rcv_tuples": (int,),
+    "ewma_service_us_per_batch": (int, float),
+    "avg_service_us_per_batch": (int, float),
+}
+
+
+def _typed(obj, field, types, ctx):
+    assert field in obj, f"{ctx}: missing field {field!r} in {obj}"
+    v = obj[field]
+    assert isinstance(v, types) and not (
+        bool not in types and isinstance(v, bool)), \
+        f"{ctx}: field {field!r} has type {type(v).__name__}, " \
+        f"wanted {[t.__name__ for t in types]}"
+    return v
+
+
+def validate_sample(sample: dict, ctx: str = "metrics.jsonl"):
+    """One metrics.jsonl record against the documented schema."""
+    for field, types in SAMPLE_FIELDS.items():
+        _typed(sample, field, types, ctx)
+    assert sample["seq"] >= 0, f"{ctx}: negative seq"
+    assert sample["t"] > 0, f"{ctx}: non-positive timestamp"
+    for node in sample["nodes"]:
+        nctx = f"{ctx} node {node.get('node')!r}"
+        for field, types in NODE_FIELDS.items():
+            v = _typed(node, field, types, nctx)
+            if field in ("depth", "hwm", "shed", "quarantined"):
+                assert v >= 0, f"{nctx}: negative {field}"
+        for field, types in NODE_OPTIONAL_FIELDS.items():
+            if field in node:
+                _typed(node, field, types, nctx)
+    for name, v in sample["counters"].items():
+        assert isinstance(v, (int, float)), \
+            f"{ctx}: counter {name!r} not numeric"
+    for name, h in sample["histograms"].items():
+        for field in ("buckets", "sum", "count"):
+            assert field in h, f"{ctx}: histogram {name!r} missing {field}"
+
+
+def validate_event(event: dict, ctx: str = "events.jsonl"):
+    """One events.jsonl record against the documented schema."""
+    _typed(event, "t", (float,), ctx)
+    kind = _typed(event, "event", (str,), ctx)
+    assert kind in EVENT_KINDS, f"{ctx}: unknown event kind {kind!r}"
+    if "node" in event:
+        _typed(event, "node", (str,), ctx)
+    json.dumps(event)   # every field must be JSON-serialisable
+
+
+def validate_file(path: str, validator) -> int:
+    """Validate every line of a JSONL file; returns the line count (a
+    caller asserting `> 0` distinguishes 'valid' from 'empty')."""
+    n = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            ctx = f"{path}:{i}"
+            assert line.endswith("\n"), f"{ctx}: torn/unterminated line"
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise AssertionError(f"{ctx}: invalid JSON: {e}") from e
+            validator(obj, ctx)
+            n += 1
+    return n

@@ -449,19 +449,120 @@ def test_union_rejects_conflicting_policies():
     assert merged.overload.error_budget == 1
 
 
+# ------------------------------------- robustness counters, end to end
+
+def test_robustness_counters_end_to_end(inbox_kind, tmp_path):
+    """ISSUE 4 satellite: one graph that BOTH sheds (overloaded sink)
+    and quarantines (poison within budget) must surface the counters in
+    all three observability views — NodeStats.snapshot() (the end-of-run
+    .log), the live sampler's metrics.jsonl, and events.jsonl — with
+    every line schema-valid (tests/obs_schema.py)."""
+    from obs_schema import validate_event, validate_file, validate_sample
+    d = str(tmp_path / "e2e")
+    delivered = [0]
+
+    def check(b):
+        if (b["value"] < 0).any():
+            raise ValueError("poison batch")
+
+    def consume(rows):
+        if rows is not None and len(rows):
+            delivered[0] += 1
+            time.sleep(0.004)
+
+    # a paced source keeps the map's inbox drained (the instant map
+    # never sheds), so every poison batch deterministically reaches
+    # check and quarantines; the slow sink's inbox is the one that
+    # overloads and sheds.  Budget exceeds the poison count so the
+    # graph always completes.
+    batches = make_batches(80, poison_at=tuple(range(0, 80, 10)))
+
+    def gen(shipper):
+        for b in batches:
+            shipper.push_batch(b.copy())
+            time.sleep(0.001)
+
+    df = Dataflow("e2e", capacity=4, trace_dir=d, sample_period=0.005,
+                  overload=OverloadPolicy(shed="shed_oldest",
+                                          error_budget=80))
+    build_pipeline(df, [
+        Source(gen, SCHEMA),
+        Map(check, name="check", vectorized=True),
+        Sink(consume, vectorized=True)])
+    df.run_and_wait_end()
+    shed_total = sum(df.shed_counts().values())
+    assert shed_total > 0 and len(df.dead_letters) >= 1
+
+    # view 1: NodeStats.snapshot() as written to the per-node .log
+    logs = {f: json.load(open(os.path.join(d, f)))
+            for f in os.listdir(d) if f.endswith(".log")}
+    sink_log = next(v for v in logs.values()
+                    if v["node"].endswith("sink.0"))
+    assert sink_log["shed"] == df.shed_counts()["sink.0"]
+    check_log = next(v for v in logs.values()
+                     if v["node"].endswith("check.0"))
+    assert check_log["quarantined"] == len(df.dead_letters)
+
+    # view 2: the live sampler's metrics.jsonl (schema-valid, and the
+    # final sample agrees with the end-of-run accounting)
+    mpath = os.path.join(d, "metrics.jsonl")
+    assert validate_file(mpath, validate_sample) >= 2
+    last = json.loads(open(mpath).read().splitlines()[-1])
+    by_node = {n["node"]: n for n in last["nodes"]}
+    assert sum(n["shed"] for n in by_node.values()) == shed_total
+    assert by_node["check.0"]["quarantined"] == len(df.dead_letters)
+    assert last["dead_letters"] == len(df.dead_letters)
+
+    # view 3: events.jsonl carries shed + quarantine events
+    epath = os.path.join(d, "events.jsonl")
+    assert validate_file(epath, validate_event) > 0
+    events = [json.loads(line) for line in open(epath)]
+    kinds = {e["event"] for e in events}
+    assert {"shed", "quarantine"} <= kinds
+    q = next(e for e in events if e["event"] == "quarantine")
+    assert q["node"] == "check.0" and q["error"] == "ValueError"
+    shed_ev_total = sum(e["n"] for e in events if e["event"] == "shed")
+    assert shed_ev_total == shed_total
+
+
 # ------------------------------------------------------------- slow soak
 
-@pytest.mark.slow
-def test_overload_soak_small():
-    """A small slice of scripts/soak_overload.py (the standalone repro
-    harness): randomized policies / capacities / poison patterns, all
-    invariants conserved."""
+def _soak_module():
     spec = importlib.util.spec_from_file_location(
         "soak_overload",
         os.path.join(os.path.dirname(os.path.dirname(__file__)),
                      "scripts", "soak_overload.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    stats = mod.run_soak(n=60, seed=123)
+    return mod
+
+
+@pytest.mark.slow
+def test_overload_soak_small():
+    """A small slice of scripts/soak_overload.py (the standalone repro
+    harness): randomized policies / capacities / poison patterns, all
+    invariants conserved."""
+    stats = _soak_module().run_soak(n=60, seed=123)
     assert stats["cases"] == 60
     assert stats["shed_cases"] > 0 and stats["poison_cases"] > 0
+
+
+@pytest.mark.slow
+def test_overload_soak_with_metrics(tmp_path):
+    """The soak with the observability layer ON (ISSUE 4 satellite):
+    every conservation invariant still holds with the sampler running,
+    and the files it leaves behind are schema-valid with live (pre-final)
+    samples showing real occupancy."""
+    from obs_schema import validate_event, validate_file, validate_sample
+    d = str(tmp_path / "soakobs")
+    stats = _soak_module().run_soak(n=25, seed=321, trace_dir=d,
+                                    sample_period=0.01)
+    assert stats["cases"] == 25 and stats["shed_cases"] > 0
+    assert validate_file(os.path.join(d, "metrics.jsonl"),
+                         validate_sample) >= 25
+    assert validate_file(os.path.join(d, "events.jsonl"),
+                         validate_event) > 0
+    samples = [json.loads(line)
+               for line in open(os.path.join(d, "metrics.jsonl"))]
+    assert max(n["depth"] for s in samples for n in s["nodes"]) > 0
+    assert max(n["shed"] for s in samples for n in s["nodes"]) > 0

@@ -54,3 +54,33 @@ def test_env_var_enables_tracing(tmp_path, monkeypatch):
     monkeypatch.setenv("WF_LOG_DIR", d)
     build().run_and_wait_end()
     assert len(os.listdir(d)) == 3
+
+
+def test_snapshot_carries_robustness_counters():
+    """NodeStats.snapshot() is the one view the end-of-run log, the live
+    sampler, and wf_top all read: the robustness counters
+    (docs/ROBUSTNESS.md) must surface there by their documented names."""
+    from windflow_tpu.utils.tracing import NodeStats
+    stats = NodeStats("df_00_check.0")
+    stats.record_svc(100, 5_000)
+    stats.record_shed(7)
+    stats.record_quarantined()
+    stats.record_quarantined()
+    snap = stats.snapshot()
+    assert snap["shed"] == 7
+    assert snap["quarantined"] == 2
+    assert snap["rcv_tuples"] == 100
+    assert snap["node"] == "df_00_check.0"
+
+
+def test_snapshot_is_live_mid_run():
+    """snapshot() readable while the node is still running — the
+    contract the background sampler (obs/sampler.py) relies on."""
+    from windflow_tpu.utils.tracing import NodeStats
+    stats = NodeStats("live")
+    before = stats.snapshot()
+    assert before["rcv_batches"] == 0
+    stats.record_svc(10, 1_000)
+    after = stats.snapshot()
+    assert after["rcv_batches"] == 1
+    assert after["alive_sec"] >= before["alive_sec"]

@@ -30,6 +30,7 @@ from .patterns.win_seq import WinSeq
 from .patterns.win_seq_tpu import (JaxWindowFunction, KeyFarmTPU,
                                    PaneFarmTPU, WinFarmTPU, WinMapReduceTPU,
                                    WinSeqTPU)
+from .obs import EventLog, MetricsRegistry
 from .runtime.node import RuntimeContext
 from .runtime.overload import DeadLetter, OverloadError, OverloadPolicy
 
@@ -57,4 +58,6 @@ __all__ = [
     "LEVEL0", "LEVEL1", "LEVEL2",
     # robustness (docs/ROBUSTNESS.md)
     "OverloadPolicy", "OverloadError", "DeadLetter",
+    # observability (docs/OBSERVABILITY.md)
+    "MetricsRegistry", "EventLog",
 ]

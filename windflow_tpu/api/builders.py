@@ -76,7 +76,11 @@ class _ParallelMixin:
 class _RichMixin:
     def withRich(self):
         """Mark the functor as RuntimeContext-receiving (the reference's
-        rich variants, e.g. map.hpp:64-68)."""
+        rich variants, e.g. map.hpp:64-68).  Beyond parallelism/index,
+        the context carries the dataflow's live metrics registry when
+        observability is on (``MultiPipe(metrics=…/sample_period=…)``,
+        docs/OBSERVABILITY.md): a rich functor may record custom
+        counters/histograms via ``ctx.metrics`` (None when off)."""
         self._kw["rich"] = True
         return self
 

@@ -85,7 +85,8 @@ class MultiPipe:
     operands of :func:`union_multipipes`."""
 
     def __init__(self, name: str = "pipe", trace_dir: str = None,
-                 capacity: int = 16, overload=None):
+                 capacity: int = 16, overload=None, metrics=None,
+                 sample_period: float = None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
@@ -96,6 +97,14 @@ class MultiPipe:
         #: poison quarantine for the materialised graph; None (default)
         #: keeps seed-identical behavior (docs/ROBUSTNESS.md)
         self.overload = overload
+        #: observability knobs (docs/OBSERVABILITY.md): `metrics` is an
+        #: obs.MetricsRegistry (or truthy for a fresh one) exposed live
+        #: via `.metrics`; `sample_period` (seconds; WF_SAMPLE_PERIOD
+        #: env) runs the background sampler writing
+        #: <trace_dir>/metrics.jsonl + events.jsonl.  Both unset =>
+        #: no thread, no files, seed-identical hot paths.
+        self._metrics_arg = metrics
+        self.sample_period = sample_period
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -272,7 +281,9 @@ class MultiPipe:
     def _build(self) -> Dataflow:
         if self._df is None:
             df = Dataflow(self.name, capacity=self.capacity,
-                      trace_dir=self.trace_dir, overload=self.overload)
+                      trace_dir=self.trace_dir, overload=self.overload,
+                      metrics=self._metrics_arg,
+                      sample_period=self.sample_period)
             self._build_into(df)
             self._df = df
         return self._df
@@ -305,6 +316,23 @@ class MultiPipe:
         """Per-node shed counters of the materialised graph (empty before
         run() and under the default blocking policy)."""
         return self._df.shed_counts() if self._df is not None else {}
+
+    @property
+    def metrics(self):
+        """The materialised graph's live obs.MetricsRegistry (None before
+        run() unless one was passed in, and always None when neither
+        `metrics` nor `sample_period` was configured)."""
+        if self._df is not None:
+            return self._df.metrics
+        from ..obs import MetricsRegistry
+        return (self._metrics_arg
+                if isinstance(self._metrics_arg, MetricsRegistry) else None)
+
+    @property
+    def events(self):
+        """The materialised graph's obs.EventLog (None before run() or
+        when observability is off); `.recent` holds the in-memory tail."""
+        return self._df.events if self._df is not None else None
 
     def getNumThreads(self) -> int:
         """Thread count of the materialised graph (multipipe.hpp:973).
@@ -352,7 +380,17 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                 f"cannot union MultiPipes with conflicting overload "
                 f"policies ({overload!r} vs {pol!r}): one Dataflow runs "
                 f"one policy — configure it on the merged pipe")
+    # observability merges like capacity: the merged graph samples at the
+    # finest requested cadence, and the first configured registry and
+    # trace_dir win (these are additive sinks, not behavior — no conflict
+    # rule needed the way overload policies need one)
+    periods = [p.sample_period for p in pipes if p.sample_period is not None]
+    registries = [p._metrics_arg for p in pipes if p._metrics_arg]
+    trace_dirs = [p.trace_dir for p in pipes if p.trace_dir is not None]
     merged = MultiPipe(name, capacity=min(p.capacity for p in pipes),
-                       overload=overload)
+                       trace_dir=trace_dirs[0] if trace_dirs else None,
+                       overload=overload,
+                       metrics=registries[0] if registries else None,
+                       sample_period=min(periods) if periods else None)
     merged._branches = list(pipes)
     return merged

@@ -165,8 +165,10 @@ def _lat_stats(state):
     if not s:
         return {"avg_window_latency_ms": 0.0}
     return {"avg_window_latency_ms": s["avg"],
+            "p50_window_latency_ms": s["p50"],
             "p95_window_latency_ms": s["p95"],
-            "p99_window_latency_ms": s["p99"]}
+            "p99_window_latency_ms": s["p99"],
+            "n_window_results": s["n"]}
 
 
 def run(n_tuples=8_000_000, pardegree=2, chunk=1 << 20,

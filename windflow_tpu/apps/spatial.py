@@ -353,8 +353,10 @@ class SpatialSink:
         return {"windows": self.received,
                 "skyline_points": self.skyline_points,
                 "avg_latency_ms": s["avg"],
+                "p50_latency_ms": s["p50"],
                 "p95_latency_ms": s["p95"],
-                "p99_latency_ms": s["p99"]}
+                "p99_latency_ms": s["p99"],
+                "n_latency_samples": s["n"]}
 
 
 def build_spatial(variant: str, duration_sec: float, pardegree: int,

@@ -217,8 +217,9 @@ class YSBSink:
         s = summarize(self._lat_us, ndigits=1)
         if not s:
             return {"avg_latency_us": 0.0}
-        return {"avg_latency_us": s["avg"], "p95_latency_us": s["p95"],
-                "p99_latency_us": s["p99"]}
+        return {"avg_latency_us": s["avg"], "p50_latency_us": s["p50"],
+                "p95_latency_us": s["p95"], "p99_latency_us": s["p99"],
+                "n_latency_samples": s["n"]}
 
     @property
     def avg_latency_us(self):

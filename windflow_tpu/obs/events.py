@@ -1,0 +1,81 @@
+"""Structured runtime event log — what *happened* to the graph, not how
+fast it ran: node start/stop, per-channel EOS, shed and quarantine,
+wire reconnect attempts, heartbeat failures, peer stalls/aborts
+(docs/OBSERVABILITY.md lists the full vocabulary).
+
+Events are rare by construction (lifecycle transitions and failures, at
+most one shed event per sampler period — never per item), so the log can
+afford a JSON line per event.  When a file path is configured the log
+appends to ``<trace_dir>/events.jsonl``; it always keeps a bounded
+in-memory ring (``recent``) so in-process supervisors and tests can read
+the tail without touching the filesystem.  The file is opened lazily on
+the first emit — constructing an EventLog (e.g. for a preview graph that
+never runs) creates nothing on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+#: the event vocabulary (docs/OBSERVABILITY.md); emitters must use these
+EVENT_KINDS = frozenset({
+    # engine lifecycle
+    "dataflow_start", "dataflow_stop", "node_start", "node_stop",
+    "node_error", "eos",
+    # overload / robustness (runtime/overload.py)
+    "shed", "quarantine",
+    # wire (parallel/channel.py)
+    "reconnect_attempt", "heartbeat_miss", "peer_stall", "peer_abort",
+})
+
+
+class EventLog:
+    """Thread-safe append-only event sink: bounded memory ring + optional
+    JSONL file (one ``{"t": ..., "event": ..., ...}`` object per line,
+    flushed per event — events are rare, and a crash must not lose the
+    events explaining it)."""
+
+    def __init__(self, path: str = None, keep: int = 512):
+        self.path = path
+        self.recent = deque(maxlen=keep)
+        self._mu = threading.Lock()
+        self._f = None
+        self._closed = False
+
+    def emit(self, event: str, **fields):
+        if event not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {event!r} "
+                             f"(add it to obs.events.EVENT_KINDS)")
+        rec = {"t": time.time(), "event": event, **fields}
+        with self._mu:
+            self.recent.append(rec)
+            # after close() the log drops to ring-only: a straggling wire
+            # thread emitting during teardown must not reopen the file
+            # (nothing would close it again) or write past dataflow_stop
+            if self.path is not None and not self._closed:
+                if self._f is None:
+                    os.makedirs(os.path.dirname(self.path) or ".",
+                                exist_ok=True)
+                    self._f = open(self.path, "a")
+                json.dump(rec, self._f)
+                self._f.write("\n")
+                self._f.flush()
+        return rec
+
+    def close(self):
+        with self._mu:
+            self._closed = True
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

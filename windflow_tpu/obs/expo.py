@@ -1,0 +1,100 @@
+"""Prometheus-style text exposition of the live metrics.
+
+Two sources render to the same format (text/plain; version=0.0.4):
+
+* :func:`render_registry` — a :class:`~windflow_tpu.obs.registry.
+  MetricsRegistry` (or its ``snapshot()`` dict): counters/gauges/
+  histograms with flat names, prefixed ``wf_``;
+* :func:`render_sample` — one ``metrics.jsonl`` line (the sampler's
+  per-node view): per-node gauges labelled ``{dataflow=...,node=...}``
+  plus the embedded registry snapshot.
+
+No HTTP server is shipped on purpose: serving one string is trivial in
+any deployment (``python -m http.server`` wrappers, a sidecar, or
+``scripts/wf_top.py --expo`` for ad-hoc scrapes), while binding ports
+from inside the engine would be policy the runtime has no business
+setting.
+"""
+
+from __future__ import annotations
+
+_PREFIX = "wf"
+
+#: per-node sample fields exposed as labelled gauges: sample key ->
+#: (metric suffix, TYPE, HELP)
+_NODE_FIELDS = {
+    "depth": ("inbox_depth", "gauge", "current inbox occupancy (items)"),
+    "hwm": ("inbox_hwm", "gauge", "inbox occupancy high-water mark"),
+    "shed": ("shed_total", "counter", "items shed from this inbox"),
+    "quarantined": ("quarantined_total", "counter",
+                    "poison batches quarantined by this node"),
+    "rcv_batches": ("rcv_batches_total", "counter", "batches processed"),
+    "rcv_tuples": ("rcv_tuples_total", "counter", "tuples processed"),
+    "ewma_service_us_per_batch": ("service_ewma_us", "gauge",
+                                  "EWMA service time per batch (us)"),
+}
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _line(name, labels, value):
+    if labels:
+        lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        return f"{name}{{{lab}}} {value}"
+    return f"{name} {value}"
+
+
+def _header(name, mtype, help_text):
+    return [f"# HELP {name} {help_text}", f"# TYPE {name} {mtype}"]
+
+
+def render_registry(registry, prefix: str = _PREFIX) -> str:
+    """Expose a MetricsRegistry (or its snapshot dict)."""
+    snap = registry if isinstance(registry, dict) else registry.snapshot()
+    out = []
+    for name, v in snap.get("counters", {}).items():
+        mn = f"{prefix}_{name}"
+        out += _header(mn, "counter", f"counter {name}")
+        out.append(_line(mn, None, v))
+    for name, v in snap.get("gauges", {}).items():
+        mn = f"{prefix}_{name}"
+        out += _header(mn, "gauge", f"gauge {name}")
+        out.append(_line(mn, None, v))
+    for name, h in snap.get("histograms", {}).items():
+        mn = f"{prefix}_{name}"
+        out += _header(mn, "histogram", f"histogram {name}")
+        for bound, cum in h["buckets"].items():
+            out.append(_line(f"{mn}_bucket", {"le": bound}, cum))
+        out.append(_line(f"{mn}_bucket", {"le": "+Inf"}, h["count"]))
+        out.append(_line(f"{mn}_sum", None, h["sum"]))
+        out.append(_line(f"{mn}_count", None, h["count"]))
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def render_sample(sample: dict, prefix: str = _PREFIX) -> str:
+    """Expose one sampler line (per-node gauges + embedded registry)."""
+    out = []
+    df = sample.get("dataflow", "")
+    for key, (suffix, mtype, help_text) in _NODE_FIELDS.items():
+        mn = f"{prefix}_node_{suffix}"
+        lines = []
+        for n in sample.get("nodes", []):
+            if key in n:
+                lines.append(_line(mn, {"dataflow": df, "node": n["node"]},
+                                   n[key]))
+        if lines:
+            out += _header(mn, mtype, help_text)
+            out += lines
+    mn = f"{prefix}_dead_letters"
+    out += _header(mn, "gauge", "quarantined batches in the dead-letter "
+                                "queue")
+    out.append(_line(mn, {"dataflow": df}, sample.get("dead_letters", 0)))
+    reg = {k: sample[k] for k in ("counters", "gauges", "histograms")
+           if k in sample}
+    if reg:
+        txt = render_registry(reg, prefix=prefix)
+        if txt:
+            out.append(txt.rstrip("\n"))
+    return "\n".join(out) + "\n"

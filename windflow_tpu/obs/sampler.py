@@ -1,0 +1,139 @@
+"""Background metrics sampler — the thread that makes a *running* graph
+visible: every ``period`` seconds it snapshots per-node inbox depth /
+high-water mark, shed and quarantine counters, the live
+``tracing.NodeStats`` counters, the dead-letter count, and the attached
+:class:`~windflow_tpu.obs.registry.MetricsRegistry` (wire counters, user
+metrics) into one JSON line of ``<trace_dir>/metrics.jsonl``.
+
+The sampler is owned by the :class:`~windflow_tpu.runtime.engine.Dataflow`
+that configured ``sample_period=``: started in ``run()``, stopped (with a
+final flush sample) in ``wait()``.  Without ``sample_period`` no thread
+exists at all, and node hot paths carry only the inbox high-water-mark
+branch (docs/OBSERVABILITY.md §overhead).
+
+Everything here reads engine state *racily on purpose*: the sampled
+values are ints/floats written under the GIL by the node threads, so a
+sample is internally slightly torn but each field is a real observed
+value — the standard monitoring trade.  A node mid-mutation (counter
+dict resize) is skipped for that one sample rather than crashing the
+sampler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..utils.tracing import node_stats_name
+
+
+class Sampler:
+    """Periodic snapshotter for one Dataflow (see module docstring)."""
+
+    def __init__(self, dataflow, period: float):
+        self.df = dataflow
+        self.period = float(period)
+        if self.period <= 0:
+            raise ValueError(f"sample_period must be positive, "
+                             f"got {period}")
+        self._stop = threading.Event()
+        self._last_shed: dict[str, int] = {}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{dataflow.name}/sampler")
+        #: samples taken (monotone; the "seq" field of the next line)
+        self.seq = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0):
+        """Request shutdown and wait for the final flush sample."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+
+    def _run(self):
+        f = None
+        if self.df.trace_dir:
+            os.makedirs(self.df.trace_dir, exist_ok=True)
+            f = open(os.path.join(self.df.trace_dir, "metrics.jsonl"), "a")
+        try:
+            while True:
+                self._write_sample(f)
+                if self._stop.wait(self.period):
+                    break
+            self._write_sample(f)   # final: the end-state snapshot
+        finally:
+            if f is not None:
+                f.close()
+
+    # ------------------------------------------------------------- sampling
+
+    def _node_entry(self, idx: int, node) -> dict:
+        inbox = self.df._inboxes.get(id(node))
+        entry = {
+            "node": node.name,
+            "id": node_stats_name(self.df.name, idx, node.name),
+            "depth": int(inbox.depth()) if inbox is not None else 0,
+            "hwm": int(getattr(inbox, "hwm", 0)),
+            "shed": int(getattr(inbox, "shed", 0)),
+            "quarantined": 0,
+        }
+        stats = node.stats
+        if stats is not None:
+            entry["quarantined"] = int(stats.counters.get("quarantined", 0))
+            entry["rcv_batches"] = stats.rcv_batches
+            entry["rcv_tuples"] = stats.rcv_tuples
+            entry["ewma_service_us_per_batch"] = round(stats.ewma_ts_us, 3)
+            entry["avg_service_us_per_batch"] = round(stats.avg_ts_us, 3)
+        return entry
+
+    def sample(self) -> dict:
+        """One observation of the whole graph (the metrics.jsonl line,
+        pre-serialisation) — a pure read, safe to call synchronously
+        (wf_top --expo, tests) while the background thread runs; only
+        the thread-owned ``_write_sample`` advances seq and emits shed
+        events."""
+        df = self.df
+        nodes = []
+        for idx, node in enumerate(df.nodes):
+            try:
+                nodes.append(self._node_entry(idx, node))
+            except Exception:   # noqa: BLE001 — torn read during a node's
+                continue        # dict resize: skip it for this sample
+        rec = {
+            "t": time.time(),
+            "seq": self.seq,
+            "dataflow": df.name,
+            "nodes": nodes,
+            "dead_letters": len(df.dead_letters),
+        }
+        if df.metrics is not None:
+            rec.update(df.metrics.snapshot())
+        return rec
+
+    def _emit_shed_events(self, nodes):
+        """Transition-based shed events: one per node per period at most
+        (per-item events would melt the log under sustained overload),
+        carrying the delta since the last sample."""
+        ev = self.df.events
+        if ev is None:
+            return
+        for n in nodes:
+            prev = self._last_shed.get(n["id"], 0)
+            if n["shed"] > prev:
+                ev.emit("shed", dataflow=self.df.name, node=n["node"],
+                        n=n["shed"] - prev, total=n["shed"])
+            self._last_shed[n["id"]] = n["shed"]
+
+    def _write_sample(self, f):
+        rec = self.sample()
+        self.seq += 1
+        self._emit_shed_events(rec["nodes"])
+        if f is not None:
+            json.dump(rec, f)
+            f.write("\n")
+            f.flush()

@@ -41,7 +41,13 @@ Design notes (DCN-analog, deliberately boring):
   - *abort vs EOS*: ``RowSender.abort()`` sends frame ``-3`` — the
     receiver raises :class:`PeerAbort` instead of counting a clean EOS,
     so a producer that died mid-stream can never silently truncate the
-    stream.
+    stream;
+  - *telemetry*: ``metrics=`` (an obs.MetricsRegistry) counts
+    bytes/frames sent and received, connect retries and heartbeats
+    sent/received/missed; ``events=`` (an obs.EventLog) records
+    reconnect attempts, heartbeat misses and peer stalls/aborts
+    (docs/OBSERVABILITY.md).  Both off (default) ⇒ the data path pays a
+    single predictable branch per frame.
 """
 
 from __future__ import annotations
@@ -159,8 +165,48 @@ _TRANSIENT_CONNECT_ERRNOS = frozenset({
 })
 
 
+class _WireTelemetry:
+    """One sender's (or receiver's) view into the observability layer:
+    pre-resolved counter handles plus the event log, so the data path
+    pays one ``self._tm is not None`` branch when telemetry is off and
+    plain counter increments when it is on (docs/OBSERVABILITY.md wire
+    counters)."""
+
+    __slots__ = ("events", "bytes_sent", "frames_sent", "bytes_recv",
+                 "frames_recv", "connect_retries", "heartbeats_sent",
+                 "heartbeats_recv", "heartbeat_misses")
+
+    def __init__(self, metrics, events=None):
+        self.events = events
+        c = metrics.counter
+        self.bytes_sent = c("wire_bytes_sent")
+        self.frames_sent = c("wire_frames_sent")
+        self.bytes_recv = c("wire_bytes_recv")
+        self.frames_recv = c("wire_frames_recv")
+        self.connect_retries = c("wire_connect_retries")
+        self.heartbeats_sent = c("wire_heartbeats_sent")
+        self.heartbeats_recv = c("wire_heartbeats_recv")
+        self.heartbeat_misses = c("wire_heartbeat_misses")
+
+    def emit(self, event: str, **fields):
+        if self.events is not None:
+            self.events.emit(event, **fields)
+
+
+def _telemetry(metrics, events):
+    """None when both knobs are off — the single-branch disabled path."""
+    if metrics is None and events is None:
+        return None
+    if metrics is None:
+        # events-only caller: counters land in a private throwaway
+        # registry so the handles stay non-None (one code path)
+        from ..obs.registry import MetricsRegistry
+        metrics = MetricsRegistry()
+    return _WireTelemetry(metrics, events)
+
+
 def _connect_with_backoff(host: str, port: int, timeout: float,
-                          deadline: float) -> socket.socket:
+                          deadline: float, tm=None) -> socket.socket:
     """Retry a refused/unreachable connect with exponential backoff and
     full jitter until `deadline` seconds have elapsed — the peer's
     receiver may simply not be up yet (hosts boot in any order)."""
@@ -189,6 +235,11 @@ def _connect_with_backoff(host: str, port: int, timeout: float,
                 f"row channel connect to {host}:{port} failed for "
                 f"{deadline}s ({attempt + 1} attempts); last error: "
                 f"{last_err}") from last_err
+        if tm is not None:
+            tm.connect_retries.inc()
+            tm.emit("reconnect_attempt", host=host, port=port,
+                    attempt=attempt + 1,
+                    error=type(last_err).__name__)
         # full jitter over an exponentially growing window, capped
         backoff = random.uniform(0, min(2.0, 0.05 * (2 ** attempt)))
         time.sleep(min(backoff, remaining))
@@ -204,13 +255,18 @@ class RowSender:
     """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 connect_deadline: float = None, heartbeat: float = None):
+                 connect_deadline: float = None, heartbeat: float = None,
+                 metrics=None, events=None):
+        #: wire telemetry (obs registry counters + event log); None —
+        #: the default — keeps every data-path hook to a single branch
+        self._tm = _telemetry(metrics, events)
         if connect_deadline is None:
             self._sock = socket.create_connection((host, port),
                                                   timeout=timeout)
         else:
             self._sock = _connect_with_backoff(host, port, timeout,
-                                               float(connect_deadline))
+                                               float(connect_deadline),
+                                               tm=self._tm)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._dtype_sent = None
         self._send_lock = threading.Lock()
@@ -251,8 +307,14 @@ class RowSender:
                     if time.monotonic() - self._last_send >= interval:
                         self._sock.sendall(_LEN.pack(_HEARTBEAT_FRAME))
                         self._last_send = time.monotonic()
+                        if self._tm is not None:
+                            self._tm.heartbeats_sent.inc()
             except OSError as e:
                 self._hb_error = e
+                if self._tm is not None:
+                    self._tm.heartbeat_misses.inc()
+                    self._tm.emit("heartbeat_miss",
+                                  error=type(e).__name__, message=str(e))
                 return
 
     def _check_alive(self):
@@ -277,6 +339,9 @@ class RowSender:
                 d = _encode_dtype(batch.dtype)
                 self._sock.sendall(_LEN.pack(len(d)) + d)
                 self._dtype_sent = batch.dtype
+                if self._tm is not None:
+                    self._tm.frames_sent.inc()
+                    self._tm.bytes_sent.inc(_LEN.size + len(d))
             elif batch.dtype != self._dtype_sent:
                 raise TypeError(
                     f"row channel dtype changed mid-stream: "
@@ -284,6 +349,9 @@ class RowSender:
             payload = np.ascontiguousarray(batch).tobytes()
             self._sock.sendall(_LEN.pack(len(payload)) + payload)
             self._last_send = time.monotonic()
+            if self._tm is not None:
+                self._tm.frames_sent.inc()
+                self._tm.bytes_sent.inc(_LEN.size + len(payload))
 
     def close(self):
         """Signal EOS (empty frame) and close the socket.  If the EOS
@@ -321,6 +389,8 @@ class RowSender:
         it is called from error paths that must not mask the original
         failure."""
         self._stop_heartbeat()
+        if self._tm is not None:
+            self._tm.emit("peer_abort", role="sender")
         try:
             with self._send_lock:
                 self._sock.sendall(_LEN.pack(_ABORT_FRAME))
@@ -343,7 +413,9 @@ class RowReceiver:
 
     def __init__(self, n_senders: int, host: str = "127.0.0.1",
                  port: int = 0, capacity: int = 64,
-                 stall_timeout: float = None, accept_timeout: float = None):
+                 stall_timeout: float = None, accept_timeout: float = None,
+                 metrics=None, events=None):
+        self._tm = _telemetry(metrics, events)  # see RowSender
         self.n_senders = int(n_senders)
         self.stall_timeout = stall_timeout
         #: bound on the ACCEPT phase: how long to wait for all senders to
@@ -407,15 +479,24 @@ class RowReceiver:
     def _next_frame(self, conn: socket.socket):
         """One payload frame (bytes), or None on clean EOS.  Heartbeat
         frames are consumed silently; an ABORT frame raises."""
+        tm = self._tm
         while True:
             n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
             if n >= 0:
-                return _read_exact(conn, n)
+                raw = _read_exact(conn, n)
+                if tm is not None:
+                    tm.frames_recv.inc()
+                    tm.bytes_recv.inc(_LEN.size + n)
+                return raw
             if n == _EOS_FRAME:
                 return None
             if n == _HEARTBEAT_FRAME:
+                if tm is not None:
+                    tm.heartbeats_recv.inc()
                 continue
             if n == _ABORT_FRAME:
+                if tm is not None:
+                    tm.emit("peer_abort", role="receiver")
                 raise PeerAbort(
                     "row channel sender ABORTED mid-stream (its process "
                     "failed): data received so far is a truncated prefix, "
@@ -438,6 +519,9 @@ class RowReceiver:
                 f"(no data or heartbeat): stalled mid-stream or "
                 f"partitioned")
             stall.__cause__ = e
+            if self._tm is not None:
+                self._tm.emit("peer_stall",
+                              stall_timeout=self.stall_timeout)
             self._q.put(stall)
         except Exception as e:  # noqa: BLE001 — ANY reader failure (IO,
             # undecodable dtype from a version-mismatched peer, bad frame)

@@ -126,7 +126,7 @@ def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
 
 
 def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
-                   wire=None):
+                   wire=None, metrics=None, events=None):
     """Build the full cross-host row data plane for a process: one
     :class:`~windflow_tpu.parallel.channel.RowReceiver` listening at
     ``addresses[my_pid]`` and one hardened
@@ -145,7 +145,16 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     degrade loudly, not hang, when a peer dies (docs/ROBUSTNESS.md).
     Connect order is safe in any boot order: the receiver is bound
     before any outbound connect, and connects retry with backoff until
-    the wire deadline."""
+    the wire deadline.
+
+    ``metrics`` (an ``obs.MetricsRegistry``) and ``events`` (an
+    ``obs.EventLog``) opt the whole plane into wire telemetry: every
+    channel of this process shares the one registry, so
+    ``wire_bytes_sent`` / ``wire_connect_retries`` / heartbeat counters
+    aggregate across peers, and reconnect/stall/abort events carry per
+    -peer detail (docs/OBSERVABILITY.md).  Pass the owning Dataflow's
+    ``.metrics`` / ``.events`` to fold the wire into its sampler
+    output; both None (default) = no telemetry, seed-identical wire."""
     from .channel import RowReceiver, RowSender, WireConfig
     if my_pid not in addresses:
         raise KeyError(f"addresses has no entry for this process "
@@ -159,7 +168,8 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                            # a peer that dies before ever connecting must
                            # surface within the boot-order budget, not
                            # hang batches() forever
-                           accept_timeout=wire.connect_deadline)
+                           accept_timeout=wire.connect_deadline,
+                           metrics=metrics, events=events)
     senders = {}
     try:
         for pid in sorted(addresses):
@@ -169,7 +179,8 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
             senders[pid] = RowSender(
                 peer_host, peer_port, timeout=wire.connect_timeout,
                 connect_deadline=wire.connect_deadline,
-                heartbeat=wire.heartbeat)
+                heartbeat=wire.heartbeat,
+                metrics=metrics, events=events)
     except Exception:
         for snd in senders.values():
             snd.abort()

@@ -79,6 +79,10 @@ class Comb(Node):
             s.n_input_channels = 1
         for s in self.stages:
             s.stats = self.stats
+            # the engine stamps the observability registry on the Comb's
+            # context; fused stages keep their own ctx (their replica
+            # index differs), so the handle is forwarded explicitly
+            s.ctx.metrics = self.ctx.metrics
             s.svc_init()
 
     def svc(self, batch, channel: int = 0):

@@ -16,6 +16,7 @@ Topology model: a directed graph of Nodes. Each node owns one Inbox; an edge
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from time import monotonic as _monotonic
@@ -55,6 +56,13 @@ class Inbox:
                                   and policy.reshapes_put) else None
         self.shed = 0
         self._shed_lock = threading.Lock()
+        #: occupancy high-water mark, maintained only when the dataflow
+        #: is observed (metrics/sample_period): the put-side cost is a
+        #: single predictable `_track` branch when off.  Updated without
+        #: a lock — a lost race understates the mark by at most one
+        #: concurrent put, a fine trade for a telemetry-only value.
+        self.hwm = 0
+        self._track = False
 
     def register_source(self) -> int:
         slot = self.n_sources
@@ -94,6 +102,15 @@ class Inbox:
             self._put_shed_oldest(src, item)
         else:  # block with a deadline
             self._put_deadline(src, item, pol.put_deadline)
+        if self._track:
+            depth = self._q.qsize()
+            if depth > self.hwm:
+                self.hwm = depth
+
+    def depth(self) -> int:
+        """Current occupancy (items incl. queued EOS frames) — sampled
+        by the observability layer, racy by design."""
+        return self._q.qsize()
 
     def _put_shed_oldest(self, src: int, item):
         while True:
@@ -164,6 +181,8 @@ class NativeInbox:
                                   and policy.reshapes_put) else None
         self.shed = 0
         self._shed_lock = threading.Lock()
+        self.hwm = 0         # see Inbox: observed-dataflow occupancy mark
+        self._track = False
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -198,30 +217,38 @@ class NativeInbox:
     def put(self, src: int, item):
         pol = self._policy
         if pol is None:
-            return self._push(src, item)
-        slot = self._slot_for(item)
-        if pol.shed == "shed_newest":
+            self._push(src, item)
+        elif pol.shed == "shed_newest":
+            slot = self._slot_for(item)
             rc = self._lib.wf_queue_try_push(self._h, src, slot)
-            if rc == 0:
-                return
-            self._items.pop(slot, None)
-            if rc < 0:
-                raise _Cancelled()
-            self._record_shed()
+            if rc != 0:
+                self._items.pop(slot, None)
+                if rc < 0:
+                    raise _Cancelled()
+                self._record_shed()
         elif pol.shed == "shed_oldest":
-            self._put_shed_oldest(src, slot)
+            self._put_shed_oldest(src, self._slot_for(item))
         else:  # block with a deadline
+            slot = self._slot_for(item)
             rc = self._lib.wf_queue_push_timed(
                 self._h, src, slot, int(pol.put_deadline * 1000))
-            if rc == 0:
-                return
-            self._items.pop(slot, None)
-            if rc < 0:
-                raise _Cancelled()
-            raise OverloadError(
-                f"inbox put blocked longer than the {pol.put_deadline}s "
-                f"deadline (native ring): downstream stage is not "
-                f"keeping up")
+            if rc != 0:
+                self._items.pop(slot, None)
+                if rc < 0:
+                    raise _Cancelled()
+                raise OverloadError(
+                    f"inbox put blocked longer than the "
+                    f"{pol.put_deadline}s deadline (native ring): "
+                    f"downstream stage is not keeping up")
+        if self._track:
+            depth = len(self._items)
+            if depth > self.hwm:
+                self.hwm = depth
+
+    def depth(self) -> int:
+        """Occupancy proxy: the payload side table holds exactly the
+        items whose slot ids sit in the ring (plus any mid-handoff)."""
+        return len(self._items)
 
     def _put_shed_oldest(self, src: int, slot: int):
         import ctypes
@@ -288,14 +315,22 @@ class Dataflow:
     multipipe.hpp:1010; same model here)."""
 
     def __init__(self, name: str = "dataflow", capacity: int = 16,
-                 trace_dir: str = None, overload: OverloadPolicy = None):
+                 trace_dir: str = None, overload: OverloadPolicy = None,
+                 metrics=None, sample_period: float = None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
         # proportional to capacity x batch size.  0 = unbounded.
         # `overload` (runtime/overload.py) opts the graph into shedding /
         # put deadlines / poison-tuple quarantine; None = seed behavior.
-        from ..utils.tracing import default_trace_dir
+        # `metrics` (a MetricsRegistry, or truthy for a fresh one) and
+        # `sample_period` (seconds; also the WF_SAMPLE_PERIOD env hook)
+        # opt into the observability layer (docs/OBSERVABILITY.md):
+        # a background sampler owned by this graph writes
+        # <trace_dir>/metrics.jsonl and a structured event log writes
+        # <trace_dir>/events.jsonl.  Both unset = no thread, no files,
+        # and inbox hot paths keep a single disabled branch.
+        from ..utils.tracing import default_sample_period, default_trace_dir
         if overload is not None and overload.reshapes_put and capacity <= 0:
             # an unbounded queue never fills: every shed/deadline knob
             # would be silently inert while memory grows without bound
@@ -308,6 +343,29 @@ class Dataflow:
         self.capacity = capacity
         self.trace_dir = trace_dir or default_trace_dir()
         self.overload = overload
+        if sample_period is None:
+            sample_period = default_sample_period()
+        if sample_period is not None and float(sample_period) <= 0:
+            raise ValueError(f"sample_period must be positive seconds, "
+                             f"got {sample_period}")
+        self.sample_period = sample_period
+        self._sampler = None
+        # truthiness, not `is not None`: metrics=False/0 must mean OFF
+        # (docs/OBSERVABILITY.md — "any truthy value for a fresh one")
+        if metrics or sample_period is not None:
+            from ..obs import EventLog, MetricsRegistry
+            #: live metrics registry shared with channels/user functions
+            self.metrics = (metrics if isinstance(metrics, MetricsRegistry)
+                            else MetricsRegistry())
+            #: structured runtime event log (file iff trace_dir is set;
+            #: the file opens lazily, so a never-run preview graph
+            #: creates nothing on disk)
+            self.events = EventLog(
+                os.path.join(self.trace_dir, "events.jsonl")
+                if self.trace_dir else None)
+        else:
+            self.metrics = None
+            self.events = None
         self.nodes: list[Node] = []
         self._inboxes: dict[int, Inbox] = {}
         self._edges: list[tuple[Node, Node]] = []
@@ -319,6 +377,7 @@ class Dataflow:
         #: is set (overload.error_budget or a node/pattern-level budget)
         self.dead_letters: list[DeadLetter] = []
         self._dead_lock = threading.Lock()
+        self._stop_logged = False
 
     def _inbox_policy(self, node: Node) -> OverloadPolicy:
         """Shedding applies only at shed-safe inboxes (farm heads and
@@ -339,8 +398,11 @@ class Dataflow:
         if ctx is not None:
             node.ctx = ctx
         self.nodes.append(node)
-        self._inboxes[id(node)] = _make_inbox(self.capacity, self._failed,
-                                              self._inbox_policy(node))
+        inbox = _make_inbox(self.capacity, self._failed,
+                            self._inbox_policy(node))
+        if self.metrics is not None or self.sample_period is not None:
+            inbox._track = True  # maintain the occupancy high-water mark
+        self._inboxes[id(node)] = inbox
         return node
 
     def connect(self, src: Node, dst: Node):
@@ -370,20 +432,34 @@ class Dataflow:
 
     def _quarantine(self, node: Node, batch, channel: int,
                     error: BaseException):
+        letter = DeadLetter(node.name, batch, channel, error)
         with self._dead_lock:
-            self.dead_letters.append(
-                DeadLetter(node.name, batch, channel, error))
+            self.dead_letters.append(letter)
         if node.stats is not None:
             node.stats.record_quarantined()
+        if self.events is not None:
+            self.events.emit("quarantine", dataflow=self.name,
+                             **letter.to_event())
 
     def _run_node(self, node: Node):
+        events = self.events
         try:
             node.n_input_channels = self._inboxes[id(node)].n_sources
-            if self.trace_dir:
-                from ..utils.tracing import NodeStats
+            if self.trace_dir or self.metrics is not None \
+                    or self.sample_period is not None:
+                from ..utils.tracing import NodeStats, node_stats_name
                 # index disambiguates same-named nodes (two 'map.0' stages)
                 idx = self.nodes.index(node)
-                node.stats = NodeStats(f"{self.name}_{idx:02d}_{node.name}")
+                node.stats = NodeStats(node_stats_name(self.name, idx,
+                                                       node.name))
+            if self.metrics is not None:
+                # rich user functions may bump custom metrics through
+                # their RuntimeContext (ctx.metrics.counter(...).inc())
+                node.ctx.metrics = self.metrics
+            if events is not None:
+                events.emit("node_start", dataflow=self.name,
+                            node=node.name,
+                            source=isinstance(node, SourceNode))
             node.svc_init()
             if isinstance(node, SourceNode):
                 node.generate()
@@ -397,6 +473,10 @@ class Dataflow:
                     if item is _EOS:
                         live -= 1
                         node.on_channel_eos(src)
+                        if events is not None:
+                            events.emit("eos", dataflow=self.name,
+                                        node=node.name, channel=src,
+                                        live=live)
                     elif budget > 0:
                         # poison-tuple quarantine: an svc error within
                         # budget parks the batch in the dead-letter queue
@@ -429,12 +509,26 @@ class Dataflow:
                 shed = getattr(self._inboxes[id(node)], "shed", 0)
                 if shed:
                     node.stats.record_shed(shed)
-                node.stats.write(self.trace_dir)
+                if self.trace_dir:
+                    node.stats.write(self.trace_dir)
+            if events is not None:
+                stop = {"dataflow": self.name, "node": node.name}
+                if node.stats is not None:
+                    stop["rcv_batches"] = node.stats.rcv_batches
+                    stop["rcv_tuples"] = node.stats.rcv_tuples
+                    stop.update({k: v for k, v
+                                 in node.stats.counters.items()
+                                 if k not in ("t", "event")})
+                events.emit("node_stop", **stop)
         except _Cancelled:
             pass  # the graph failed elsewhere; exit quietly
         except BaseException as e:  # propagate to run_and_wait_end
             self._errors.append(e)
             self._failed.set()  # unblock producers stuck on our inbox
+            if events is not None:
+                events.emit("node_error", dataflow=self.name,
+                            node=node.name, error=type(e).__name__,
+                            message=str(e))
             for inbox in self._inboxes.values():
                 inbox.cancel()  # native rings wake instantly
         finally:
@@ -448,15 +542,34 @@ class Dataflow:
         if self._threads:
             raise RuntimeError(
                 f"Dataflow {self.name!r} already started; a graph runs once")
+        if self.events is not None:
+            self.events.emit("dataflow_start", dataflow=self.name,
+                             nodes=len(self.nodes),
+                             sample_period=self.sample_period)
         for node in self.nodes:
             t = threading.Thread(target=self._run_node, args=(node,),
                                  name=f"{self.name}/{node.name}", daemon=True)
             self._threads.append(t)
             t.start()
+        if self.sample_period is not None and self._sampler is None:
+            from ..obs.sampler import Sampler
+            self._sampler = Sampler(self, self.sample_period)
+            self._sampler.start()
 
     def wait(self):
-        for t in self._threads:
-            t.join()
+        try:
+            for t in self._threads:
+                t.join()
+        finally:
+            if self._sampler is not None:
+                self._sampler.stop()   # takes the final flush sample
+                self._sampler = None
+            if self.events is not None and not self._stop_logged:
+                self._stop_logged = True
+                self.events.emit("dataflow_stop", dataflow=self.name,
+                                 errors=len(self._errors),
+                                 dead_letters=len(self.dead_letters))
+                self.events.close()
         if self._errors:
             raise self._errors[0]
 

@@ -21,14 +21,22 @@ from __future__ import annotations
 class RuntimeContext:
     """Execution context handed to "rich" user functions
     (reference context.hpp:45-80): the replica's parallelism degree and
-    index within its pattern."""
+    index within its pattern.
 
-    __slots__ = ("parallelism", "index", "name")
+    When the owning dataflow runs with a metrics registry
+    (``metrics=`` / ``sample_period=``, docs/OBSERVABILITY.md), the
+    engine stamps it on ``ctx.metrics`` before ``svc_init`` so rich
+    functions can record custom metrics
+    (``ctx.metrics.counter("late_rows").inc(n)``); ``None`` otherwise —
+    the no-observability default costs user code one attribute check."""
+
+    __slots__ = ("parallelism", "index", "name", "metrics")
 
     def __init__(self, parallelism: int = 1, index: int = 0, name: str = ""):
         self.parallelism = parallelism
         self.index = index
         self.name = name
+        self.metrics = None
 
     def getParallelism(self) -> int:
         return self.parallelism

@@ -115,3 +115,16 @@ class DeadLetter:
         rows = len(self.batch) if hasattr(self.batch, "__len__") else "?"
         return (f"<DeadLetter node={self.node!r} rows={rows} "
                 f"error={type(self.error).__name__}: {self.error}>")
+
+    def to_event(self) -> dict:
+        """JSON-safe summary for the runtime event log
+        (obs/events.py ``quarantine`` events): everything but the batch
+        payload itself, which stays only in ``Dataflow.dead_letters``."""
+        return {
+            "node": self.node,
+            "channel": self.channel,
+            "rows": (len(self.batch)
+                     if hasattr(self.batch, "__len__") else None),
+            "error": type(self.error).__name__,
+            "message": str(self.error),
+        }

@@ -5,6 +5,17 @@ so the interesting split is host bookkeeping vs ``device_put`` staging vs
 dispatch vs harvest blocking.  Timers are process-wide and near-free when
 disabled; ``report()`` returns {phase: (seconds, calls)} and ``counters()``
 plain accumulators (bytes shipped, launches, rows).
+
+Enablement is *not* frozen at import: ``WF_PROFILE`` is re-read lazily at
+every ``span`` entry (spans bracket ms-scale ship phases, so the environ
+lookup is noise there), and the parsed value is cached so ``add()`` —
+the per-block hot probe — pays only a bare global read.  A test that
+monkeypatches the environment, or a live session toggling telemetry
+alongside ``wf_top``, thus takes effect without re-importing the module
+(for ``add()``: at the next span entry).  ``enable()`` / ``disable()``
+pin the state explicitly (and stop the env reads entirely); ``auto()``
+returns to env-driven behavior.  The module-level ``ENABLED`` mirror is
+kept for introspection and refreshed by every span entry.
 """
 
 from __future__ import annotations
@@ -14,7 +25,55 @@ import threading
 import time
 from collections import defaultdict
 
-ENABLED = bool(int(os.environ.get("WF_PROFILE", "0") or "0"))
+_FORCED: bool | None = None   # enable()/disable() override; None = env
+
+
+_env_raw = object()       # last seen WF_PROFILE string (sentinel: never)
+_env_parsed = False
+
+
+def _env_enabled() -> bool:
+    # probe cost must stay near the old module-global read: one environ
+    # lookup plus a short-string compare (os.environ.get decodes a fresh
+    # str per call, so identity can't be used); the int() parse runs
+    # only when the variable actually changed
+    global _env_raw, _env_parsed
+    raw = os.environ.get("WF_PROFILE")
+    if raw != _env_raw:
+        _env_parsed = bool(int(raw or "0"))
+        _env_raw = raw
+    return _env_parsed
+
+
+#: introspection mirror of the last observed state (back-compat with the
+#: historical import-time constant); the source of truth is _enabled()
+ENABLED = _env_enabled()
+
+
+def _enabled() -> bool:
+    global ENABLED
+    if _FORCED is None:
+        ENABLED = _env_enabled()
+    return ENABLED
+
+
+def enable():
+    """Pin profiling ON regardless of WF_PROFILE (until auto())."""
+    global _FORCED, ENABLED
+    _FORCED = ENABLED = True
+
+
+def disable():
+    """Pin profiling OFF regardless of WF_PROFILE (until auto())."""
+    global _FORCED, ENABLED
+    _FORCED = ENABLED = False
+
+
+def auto():
+    """Drop any enable()/disable() pin: follow WF_PROFILE again."""
+    global _FORCED, ENABLED
+    _FORCED = None
+    ENABLED = _env_enabled()
 
 _acc: dict[str, float] = defaultdict(float)
 _cnt: dict[str, int] = defaultdict(int)
@@ -33,12 +92,13 @@ class span:
         self.name = name
 
     def __enter__(self):
-        if ENABLED:
-            self.t0 = time.perf_counter()
+        # the span brackets ONE decision: __exit__ accumulates iff t0
+        # was stamped, so a mid-span toggle cannot read a stale t0
+        self.t0 = time.perf_counter() if _enabled() else None
         return self
 
     def __exit__(self, *exc):
-        if ENABLED:
+        if self.t0 is not None:
             dt = time.perf_counter() - self.t0
             with _mu:
                 _acc[self.name] += dt
@@ -47,7 +107,10 @@ class span:
 
 
 def add(name: str, value: float = 1.0):
-    """Accumulate a plain counter (bytes, rows, launches)."""
+    """Accumulate a plain counter (bytes, rows, launches).  Reads the
+    cached ENABLED mirror — a bare global, the cheapest possible disabled
+    path — so an env toggle reaches add() at the next span entry (spans
+    and adds interleave per shipped block, so staleness is one block)."""
     if ENABLED:
         with _mu:
             _val[name] += value

@@ -9,6 +9,13 @@ split; at ``svc_end`` the counters are written to
 Enabled at runtime (no recompilation): pass ``trace_dir=`` to
 :class:`~windflow_tpu.runtime.engine.Dataflow` / ``MultiPipe``, or set the
 ``WF_LOG_DIR`` environment variable (the spiritual ``-DLOG_DIR``).
+
+These counters also feed the *live* observability layer: when the
+dataflow runs with ``metrics=`` / ``sample_period=`` the engine creates a
+``NodeStats`` per node even without a trace dir, and the background
+sampler (obs/sampler.py) reads ``snapshot()``-equivalent fields racily
+while the graph runs — end-of-run files stay gated on ``trace_dir``
+alone, so the seed tracing behavior is unchanged.
 """
 
 from __future__ import annotations
@@ -20,6 +27,13 @@ import time
 #: EWMA smoothing for service/inter-departure times (the reference keeps a
 #: plain running average; we record both)
 ALPHA = 0.1
+
+
+def node_stats_name(dataflow_name: str, idx: int, node_name: str) -> str:
+    """Canonical per-node id: the NodeStats name, the ``<trace_dir>/*.log``
+    filename stem, and the ``id`` field of every metrics.jsonl node entry
+    — one definition so the three can never drift apart."""
+    return f"{dataflow_name}_{idx:02d}_{node_name}"
 
 
 class NodeStats:
@@ -104,3 +118,19 @@ class NodeStats:
 def default_trace_dir() -> str | None:
     """The WF_LOG_DIR environment hook (the -DLOG_DIR analog)."""
     return os.environ.get("WF_LOG_DIR") or None
+
+
+def default_sample_period() -> float | None:
+    """The WF_SAMPLE_PERIOD environment hook: seconds between live
+    metrics samples (obs/sampler.py).  Lets any existing program — the
+    benchmarks, scripts/soak_overload.py — opt into in-flight telemetry
+    with no code change, exactly like WF_LOG_DIR enables end-of-run
+    tracing.  Unset/empty = no sampler thread (docs/OBSERVABILITY.md)."""
+    raw = os.environ.get("WF_SAMPLE_PERIOD")
+    if not raw:
+        return None
+    period = float(raw)
+    if period <= 0:
+        raise ValueError(
+            f"WF_SAMPLE_PERIOD must be positive seconds, got {raw!r}")
+    return period
