@@ -1,0 +1,156 @@
+"""Seeded crash-recovery soak: randomized windowed graphs with kill-point
+injection into a stateful worker, recovered under a RecoveryPolicy and
+checked *differentially* against the same graph's uncrashed run — the
+recovered output must be byte-identical (docs/ROBUSTNESS.md "Recovery").
+
+Mirrors the soak_overload.py pattern: standalone, seeded, and any failure
+is reproducible in isolation:
+
+    python scripts/soak_crash.py --n 200 --seed 11       # the soak
+    python scripts/soak_crash.py --seed 11 --case 42     # one repro
+
+The test suite runs a small slow-marked slice of this via
+tests/test_recovery.py (tier-1 excludes it with -m 'not slow').
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _batches(schema, n_batches, rows, n_keys, seed):
+    rng = np.random.default_rng((seed, 0xbeef))
+    ctr = {}
+    for _ in range(n_batches):
+        b = np.zeros(rows, dtype=schema.dtype())
+        keys = rng.integers(0, n_keys, rows)
+        b["key"] = keys
+        b["value"] = rng.integers(0, 1000, rows)
+        for i, k in enumerate(keys.tolist()):
+            b["id"][i] = ctr.get(k, 0)
+            ctr[k] = ctr.get(k, 0) + 1
+        b["ts"] = b["id"]
+        yield b
+
+
+def run_case(seed: int, case: int, verbose: bool = False) -> dict:
+    """One randomized crash-recovery case; raises AssertionError (with
+    the repro command in the message) on any divergence from the
+    uncrashed differential oracle."""
+    from windflow_tpu import (RecoveryPolicy, Reducer, Sink, Source,
+                              WinFarm, WinSeq)
+    from windflow_tpu.core.tuples import Schema
+    from windflow_tpu.core.windows import WinType
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+
+    rng = np.random.default_rng((seed, case))
+    schema = Schema(value=np.int64)
+    n_batches = int(rng.integers(10, 40))
+    rows = int(rng.integers(16, 80))
+    n_keys = int(rng.integers(1, 8))
+    win = int(rng.integers(2, 16))
+    slide = int(rng.integers(1, win + 1))
+    win_type = WinType.CB if rng.random() < 0.7 else WinType.TB
+    farm = bool(rng.random() < 0.4)
+    pardegree = int(rng.integers(2, 4)) if farm else 1
+    epoch_batches = int(rng.integers(2, 12))
+    n_kills = int(rng.integers(1, 3))
+    # farm workers share one svc-call counter across pardegree replicas
+    # (the window-range multicast roughly multiplies calls), so late
+    # kill points need the wider range
+    kill_at = sorted(set(
+        rng.integers(1, max(n_batches * (pardegree if farm else 1), 2),
+                     size=n_kills).tolist()))
+    use_nic = bool(rng.random() < 0.3) and not farm
+    params = dict(n_batches=n_batches, rows=rows, n_keys=n_keys, win=win,
+                  slide=slide, win_type=win_type.name, farm=farm,
+                  pardegree=pardegree, epoch_batches=epoch_batches,
+                  kill_at=kill_at, use_nic=use_nic)
+    repro = f"python scripts/soak_crash.py --seed {seed} --case {case}"
+    if verbose:
+        print(f"case {case}: {params}")
+
+    def pattern():
+        if farm:
+            return WinFarm(Reducer("sum", "value"), win, slide, win_type,
+                           pardegree=pardegree, name="w")
+        if use_nic:
+            return WinSeq(
+                lambda key, gwid, rows_: (int(rows_["value"].sum()),),
+                win, slide, win_type, name="w",
+                result_fields={"value": np.int64})
+        return WinSeq(Reducer("sum", "value"), win, slide, win_type,
+                      name="w")
+
+    def run(recovery=None, kills=()):
+        out = []
+        df = Dataflow(f"soak{case}", capacity=8, recovery=recovery)
+        build_pipeline(df, [
+            Source(batches=lambda i: _batches(schema, n_batches, rows,
+                                              n_keys, seed + case),
+                   name="src"),
+            pattern(),
+            Sink(lambda r: out.append((int(r["key"]), int(r["id"]),
+                                       int(r["value"])))
+                 if r is not None else None, name="sink"),
+        ])
+        workers = [n for n in df.nodes
+                   if n.name == "w" or n.name.startswith("w.")
+                   or n.name.startswith("w_")]
+        workers = [n for n in workers
+                   if "emitter" not in n.name and "collector" not in n.name]
+        state = {"n": 0, "todo": sorted(kills, reverse=True)}
+        for node in workers:
+            orig = node.svc
+
+            def svc(batch, channel=0, _orig=orig):
+                state["n"] += 1
+                if state["todo"] and state["n"] >= state["todo"][-1]:
+                    state["todo"].pop()
+                    raise RuntimeError(f"{repro}: injected crash "
+                                       f"@svc {state['n']}")
+                return _orig(batch, channel)
+
+            node.svc = svc
+        df.run_and_wait_end(timeout=120)
+        return out
+
+    oracle = run()
+    pol = RecoveryPolicy(epoch_batches=epoch_batches,
+                         max_restarts=n_kills + 1,
+                         restart_backoff=0.005)
+    got = run(recovery=pol, kills=kill_at)
+    if farm:
+        oracle, got = sorted(oracle), sorted(got)
+    assert got == oracle, (
+        f"{repro}: recovered output diverged from the uncrashed oracle "
+        f"({len(got)} vs {len(oracle)} rows; params {params})")
+    return params
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=100, help="number of cases")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--case", type=int, default=None,
+                    help="run exactly one case (repro mode)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    if args.case is not None:
+        run_case(args.seed, args.case, verbose=True)
+        print("OK")
+        return
+    for case in range(args.n):
+        run_case(args.seed, case, verbose=args.verbose)
+        if (case + 1) % 10 == 0:
+            print(f"{case + 1}/{args.n} cases OK")
+    print(f"all {args.n} cases OK")
+
+
+if __name__ == "__main__":
+    main()

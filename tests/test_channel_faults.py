@@ -325,3 +325,76 @@ def test_open_row_plane_rejects_unknown_pid():
     with pytest.raises(KeyError, match="no entry"):
         from windflow_tpu.parallel.multihost import open_row_plane
         open_row_plane(7, {0: ("127.0.0.1", 1)})
+
+
+# ------------------------------------------------- wire epoch alignment
+
+def test_wire_epoch_frames_align_across_senders():
+    """RowSender.send_epoch / batches(epoch_markers=True): the receiver
+    yields EpochMarker(e) only once EVERY sender shipped its epoch-e
+    frame — after all rows of epochs <= e, before any later-epoch row
+    (rows from senders that run ahead are held back)."""
+    from windflow_tpu.recovery.epoch import EpochMarker
+
+    recv = RowReceiver(n_senders=2)
+    s_a = RowSender("127.0.0.1", recv.port)
+    s_b = RowSender("127.0.0.1", recv.port)
+    # sender A runs two epochs ahead of sender B
+    s_a.send(mk_batch(4, lo=0))        # epoch 1 content
+    s_a.send_epoch(1)
+    s_a.send(mk_batch(4, lo=100))      # epoch 2 content
+    s_a.send_epoch(2)
+    s_a.send(mk_batch(4, lo=200))      # tail content
+    time.sleep(0.2)                    # let A's frames land first
+    s_b.send(mk_batch(4, lo=1000))     # epoch 1 content
+    s_b.send_epoch(1)
+    s_b.send(mk_batch(4, lo=1100))     # epoch 2 content
+    s_b.send_epoch(2)
+    s_a.close()
+    s_b.close()
+    seq = list(recv.batches(epoch_markers=True))
+    markers = [i for i, x in enumerate(seq) if isinstance(x, EpochMarker)]
+    assert [seq[i].epoch for i in markers] == [1, 2]
+    m1, m2 = markers
+    lows = lambda idxs: {int(seq[i]["value"][0]) for i in idxs
+                         if not isinstance(seq[i], EpochMarker)}
+    # every epoch-1 row before marker 1; epoch-2 rows between the
+    # markers; A's tail after marker 2
+    assert {0, 1000} <= lows(range(m1))
+    assert lows(range(m1)) & {100, 1100, 200} == set()
+    assert lows(range(m1 + 1, m2)) == {100, 1100}
+    assert lows(range(m2 + 1, len(seq))) == {200}
+    # total content is conserved
+    assert sum(len(x) for x in seq
+               if not isinstance(x, EpochMarker)) == 20
+
+
+def test_wire_epoch_frames_silent_without_optin():
+    """Default batches() consumes epoch frames silently: same yielded
+    rows as the un-epoched protocol."""
+    recv = RowReceiver(n_senders=1)
+    snd = RowSender("127.0.0.1", recv.port)
+    snd.send(mk_batch(4))
+    snd.send_epoch(1)
+    snd.send(mk_batch(4, lo=50))
+    snd.close()
+    got = list(recv.batches())
+    assert all(isinstance(b, np.ndarray) for b in got)
+    assert sum(len(b) for b in got) == 8
+
+
+def test_wire_epoch_eos_releases_held_rows():
+    """A sender that closes while ahead of the barrier: EOS aligns it to
+    every epoch, so held rows drain instead of truncating the stream."""
+    recv = RowReceiver(n_senders=2)
+    s_a = RowSender("127.0.0.1", recv.port)
+    s_b = RowSender("127.0.0.1", recv.port)
+    s_a.send(mk_batch(3))
+    s_a.send_epoch(5)
+    s_a.send(mk_batch(3, lo=10))   # beyond any epoch B will reach
+    s_a.close()
+    s_b.send(mk_batch(3, lo=20))
+    s_b.close()                    # B never ships an epoch frame
+    got = list(recv.batches(epoch_markers=True))
+    rows = sum(len(x) for x in got if isinstance(x, np.ndarray))
+    assert rows == 9               # nothing held forever, nothing lost

@@ -1,0 +1,611 @@
+"""Recovery layer tests (windflow_tpu/recovery/, docs/ROBUSTNESS.md
+"Recovery"): epoch checkpoints, supervised restart, and the differential
+oracle — a graph that crashes a stateful worker mid-stream and recovers
+must produce byte-identical window results to the never-crashed run.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from windflow_tpu import (MultiPipe, RecoveryPolicy, Reducer, Sink, Source,
+                          WinFarm, WinSeq, union_multipipes)
+from windflow_tpu.core.tuples import Schema
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.recovery import CheckpointStore
+from windflow_tpu.recovery.store import resolve_state
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.farm import build_pipeline
+
+SCHEMA = Schema(value=np.int64)
+
+
+def keyed_batches(n_batches=40, rows=50, n_keys=5, seed=7):
+    """Per-key dense ids / monotone ts — the pristine-source contract CB
+    windows want."""
+    rng = np.random.default_rng(seed)
+    ctr = {}
+    for _ in range(n_batches):
+        b = np.zeros(rows, dtype=SCHEMA.dtype())
+        keys = rng.integers(0, n_keys, rows)
+        b["key"] = keys
+        b["value"] = rng.integers(0, 100, rows)
+        for i, k in enumerate(keys.tolist()):
+            b["id"][i] = ctr.get(k, 0)
+            ctr[k] = ctr.get(k, 0) + 1
+        b["ts"] = b["id"]
+        yield b
+
+
+def install_kill_point(node, kill_at: int, exc=RuntimeError):
+    """Monkey-wrap ``node.svc`` to raise once on its ``kill_at``-th call
+    — the transient-fault model (OOM, device error, preemption): the
+    same batch succeeds when replayed."""
+    orig = node.svc
+    state = {"n": 0, "fired": False}
+
+    def svc(batch, channel=0):
+        state["n"] += 1
+        if not state["fired"] and state["n"] == kill_at:
+            state["fired"] = True
+            raise exc(f"injected crash at svc #{kill_at}")
+        return orig(batch, channel)
+
+    node.svc = svc
+    return state
+
+
+def find_node(df, prefix):
+    nodes = [n for n in df.nodes if n.name.startswith(prefix)]
+    assert nodes, f"no node named {prefix}* in {[n.name for n in df.nodes]}"
+    return nodes[0]
+
+
+def rows_of(out):
+    return [tuple(int(x) for x in r) for r in out]
+
+
+# --------------------------------------------------------------- policy
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        RecoveryPolicy(epoch_batches=0)
+    with pytest.raises(ValueError):
+        RecoveryPolicy(epoch_period=-1)
+    with pytest.raises(ValueError):
+        RecoveryPolicy(retain=0)
+    with pytest.raises(ValueError):
+        RecoveryPolicy(replay_capacity=0)
+    with pytest.raises(TypeError):
+        Dataflow("x", recovery=object())
+    assert RecoveryPolicy(epoch_batches=5).agrees_with(
+        RecoveryPolicy(epoch_batches=5))
+    assert not RecoveryPolicy(epoch_batches=5).agrees_with(
+        RecoveryPolicy(epoch_batches=6))
+
+
+def test_unset_recovery_is_seed_identical_wiring():
+    """No policy => no recovery records, no supervisor, no envelopes."""
+    out = []
+    df = Dataflow("plain", capacity=8)
+    build_pipeline(df, [
+        Source(batches=lambda i: keyed_batches(4), name="src"),
+        Sink(lambda r: out.append(r) if r is not None else None,
+             name="sink"),
+    ])
+    df.run_and_wait_end()
+    assert df._supervisor is None
+    assert all(n._recov is None for n in df.nodes)
+
+
+# ------------------------------------------------- differential restarts
+
+
+def winseq_pipe(out, recovery=None, nic=True):
+    """Source -> WinSeq(sum, CB 8/4) -> Sink as a manual Dataflow.
+    ``nic=True`` uses an arbitrary host function (general per-key core);
+    ``nic=False`` a Reducer (vectorised multi-key core)."""
+    if nic:
+        fn = WinSeq(lambda key, gwid, rows: (int(rows["value"].sum()),),
+                    win_len=8, slide_len=4,
+                    result_fields={"value": np.int64})
+    else:
+        fn = WinSeq(Reducer("sum", "value"), win_len=8, slide_len=4)
+    df = Dataflow("t", capacity=8, recovery=recovery)
+    build_pipeline(df, [
+        Source(batches=lambda i: keyed_batches(), name="src"),
+        fn,
+        Sink(lambda r: out.append((int(r["key"]), int(r["id"]),
+                                   int(r["value"])))
+             if r is not None else None, name="sink"),
+    ])
+    return df
+
+
+@pytest.mark.parametrize("nic", [True, False])
+@pytest.mark.parametrize("kill_at", [2, 7, 17, 39])
+def test_winseq_crash_matches_uncrashed_oracle(nic, kill_at):
+    """Kill-point mid-window, then the restored + replayed run must be
+    byte-identical to the differential oracle (the same pipeline, never
+    crashed)."""
+    oracle = []
+    winseq_pipe(oracle, nic=nic).run_and_wait_end(timeout=120)
+    got = []
+    pol = RecoveryPolicy(epoch_batches=5, restart_backoff=0.01)
+    df = winseq_pipe(got, recovery=pol, nic=nic)
+    install_kill_point(find_node(df, "win_seq"), kill_at)
+    df.run_and_wait_end(timeout=120)
+    assert got == oracle
+
+
+def test_flush_crash_recovers():
+    """A crash in the EOS flush (eosnotify) restores, replays, and
+    re-flushes — still byte-identical."""
+    oracle = []
+    winseq_pipe(oracle).run_and_wait_end(timeout=120)
+    got = []
+    df = winseq_pipe(got, recovery=RecoveryPolicy(epoch_batches=5,
+                                                  restart_backoff=0.01))
+    node = find_node(df, "win_seq")
+    orig = node.eosnotify
+    fired = []
+
+    def eosnotify():
+        if not fired:
+            fired.append(1)
+            raise RuntimeError("injected flush crash")
+        return orig()
+
+    node.eosnotify = eosnotify
+    df.run_and_wait_end(timeout=120)
+    assert got == oracle
+
+
+def test_crash_without_recovery_still_fails():
+    got = []
+    df = winseq_pipe(got)
+    install_kill_point(find_node(df, "win_seq"), 5)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        df.run_and_wait_end(timeout=120)
+
+
+def test_restart_budget_exhausted_fails_like_seed():
+    """A persistent (non-transient) fault drains the restart budget and
+    then propagates exactly like the un-supervised engine."""
+    got = []
+    df = winseq_pipe(got, recovery=RecoveryPolicy(
+        epoch_batches=5, max_restarts=2, restart_backoff=0.001))
+    node = find_node(df, "win_seq")
+    orig = node.svc
+    state = {"n": 0}
+
+    def svc(batch, channel=0):
+        state["n"] += 1
+        if state["n"] >= 10:    # fails on every call from then on
+            raise RuntimeError("persistent fault")
+        return orig(batch, channel)
+
+    node.svc = svc
+    with pytest.raises(RuntimeError, match="persistent fault"):
+        df.run_and_wait_end(timeout=120)
+
+
+def farm_rows(out):
+    return sorted(rows_of(out))
+
+
+def test_winfarm_worker_crash_differential():
+    """Parallel stateful workers: kill one Win_Farm worker mid-stream;
+    recovered results match the uncrashed oracle (sorted by (key, id):
+    worker->collector interleave is scheduling-dependent either way,
+    per-key order is pinned by the dense result ids)."""
+
+    def build(out, recovery=None):
+        pipe = MultiPipe("farm", capacity=8, recovery=recovery)
+        pipe.add_source(Source(batches=lambda i: keyed_batches(),
+                               name="src"))
+        pipe.add(WinFarm(Reducer("sum", "value"), win_len=8, slide_len=4,
+                         pardegree=2, name="wf"))
+        pipe.add_sink(Sink(
+            lambda r: out.append((int(r["key"]), int(r["id"]),
+                                  int(r["value"])))
+            if r is not None else None, name="sink"))
+        return pipe
+
+    oracle = []
+    build(oracle).run_and_wait_end(timeout=120)
+    got = []
+    pipe = build(got, recovery=RecoveryPolicy(epoch_batches=5,
+                                              restart_backoff=0.01))
+    df = pipe._build()
+    install_kill_point(find_node(df, "wf.1"), 9)
+    pipe.run()
+    pipe.wait(timeout=120)
+    assert farm_rows(got) == farm_rows(oracle)
+
+
+@pytest.mark.parametrize("victim", ["w", "u.order_merge"])
+def test_union_multi_input_alignment_and_crash(victim):
+    """Two sources => epoch barriers align across merged inputs; a
+    mid-stream crash still matches the oracle — both at the window
+    stage and at the multi-input ordering merge itself (the node whose
+    snapshot cut actually holds items back; its journal contains
+    held-at-commit items, the restore path's hardest case)."""
+
+    def monotone_batches(parity, n_batches=20, rows=40, n_keys=3, seed=1):
+        """Globally ts-monotone per source (the union merge's global
+        watermark contract), disjoint ts parity across the two sources
+        so the merged order is fully deterministic."""
+        rng = np.random.default_rng(seed)
+        t = parity
+        for _ in range(n_batches):
+            b = np.zeros(rows, dtype=SCHEMA.dtype())
+            b["key"] = rng.integers(0, n_keys, rows)
+            b["value"] = rng.integers(0, 100, rows)
+            b["ts"] = t + 2 * np.arange(rows)
+            b["id"] = b["ts"]
+            t += 2 * rows
+            yield b
+
+    def build(out, recovery=None):
+        a = MultiPipe("a").add_source(Source(
+            batches=lambda i: monotone_batches(0, seed=1), name="src_a"))
+        b = MultiPipe("b").add_source(Source(
+            batches=lambda i: monotone_batches(1, seed=2), name="src_b"))
+        u = union_multipipes(a, b, name="u")
+        u.recovery = recovery
+        u.add(WinSeq(Reducer("sum", "value"), win_len=6, slide_len=6,
+                     win_type=WinType.TB, name="w"))
+        u.add_sink(Sink(
+            lambda r: out.append((int(r["key"]), int(r["id"]),
+                                  int(r["value"])))
+            if r is not None else None, name="sink"))
+        return u
+
+    oracle = []
+    build(oracle).run_and_wait_end(timeout=120)
+    got = []
+    pipe = build(got, recovery=RecoveryPolicy(epoch_batches=4,
+                                              restart_backoff=0.01))
+    df = pipe._build()
+    install_kill_point(find_node(df, victim), 11)
+    pipe.run_and_wait_end(timeout=120)
+    assert farm_rows(got) == farm_rows(oracle)
+
+
+def test_accumulator_crash_differential():
+    from windflow_tpu.patterns.basic import Accumulator
+
+    def build(out, recovery=None):
+        acc = Accumulator(lambda row, a: a.__setitem__(
+            "value", a["value"] + row["value"]), SCHEMA, name="acc")
+        df = Dataflow("acc", capacity=8, recovery=recovery)
+        build_pipeline(df, [
+            Source(batches=lambda i: keyed_batches(n_batches=15),
+                   name="src"),
+            acc,
+            Sink(lambda r: out.append((int(r["key"]), int(r["id"]),
+                                       int(r["value"])))
+                 if r is not None else None, name="sink"),
+        ])
+        return df
+
+    oracle = []
+    build(oracle).run_and_wait_end(timeout=120)
+    got = []
+    df = build(got, recovery=RecoveryPolicy(epoch_batches=4,
+                                            restart_backoff=0.01))
+    install_kill_point(find_node(df, "acc"), 8)
+    df.run_and_wait_end(timeout=120)
+    assert got == oracle
+
+
+def _device_pipe(out, recovery=None, **tpu_kw):
+    from windflow_tpu.patterns.win_seq_tpu import WinSeqTPU
+    df = Dataflow("dev", capacity=8, recovery=recovery)
+    build_pipeline(df, [
+        Source(batches=lambda i: keyed_batches(n_batches=20),
+               name="src"),
+        WinSeqTPU(Reducer("sum", "value"), win_len=8, slide_len=4,
+                  batch_len=16, name="wtpu", **tpu_kw),
+        Sink(lambda r: out.append((int(r["key"]), int(r["id"]),
+                                   int(r["value"])))
+             if r is not None else None, name="sink"),
+    ])
+    return df
+
+
+def test_resident_core_crash_differential(monkeypatch):
+    """Resident-ring window core: the epoch snapshot drains the launch
+    queue and captures the ring via the async device->host handle
+    (ops/resident.RingSnapshot); a crash mid-stream restores the ring +
+    host bookkeeping and replays to oracle-identical results.
+    WF_NO_NATIVE_CORE pins the recoverable Python resident core (the
+    C++ core declines snapshots, patterns/native_core.py)."""
+    monkeypatch.setenv("WF_NO_NATIVE_CORE", "1")
+    oracle = []
+    _device_pipe(oracle).run_and_wait_end(timeout=300)
+    got = []
+    df = _device_pipe(got, recovery=RecoveryPolicy(epoch_batches=4,
+                                                   restart_backoff=0.01))
+    from windflow_tpu.patterns.win_seq_tpu import ResidentWinSeqCore
+    assert isinstance(find_node(df, "wtpu").core, ResidentWinSeqCore)
+    install_kill_point(find_node(df, "wtpu"), 9)
+    df.run_and_wait_end(timeout=300)
+    assert got == oracle
+
+
+def test_resident_core_crash_without_ring_snapshot(monkeypatch):
+    """snapshot_rings=False restores by rebasing the ring from the
+    host-live archive rows instead of the device->host copy."""
+    monkeypatch.setenv("WF_NO_NATIVE_CORE", "1")
+    oracle = []
+    _device_pipe(oracle).run_and_wait_end(timeout=300)
+    got = []
+    df = _device_pipe(got, recovery=RecoveryPolicy(
+        epoch_batches=4, restart_backoff=0.01, snapshot_rings=False))
+    install_kill_point(find_node(df, "wtpu"), 13)
+    df.run_and_wait_end(timeout=300)
+    assert got == oracle
+
+
+def test_restaging_core_crash_differential():
+    """Segment-restaging device core (float sum stays off the resident
+    path): the executor keeps no cross-launch state, so the snapshot is
+    the host bookkeeping alone plus a pre-snapshot drain."""
+    oracle = []
+    _device_pipe(oracle, use_resident=False).run_and_wait_end(timeout=300)
+    got = []
+    df = _device_pipe(got, use_resident=False,
+                      recovery=RecoveryPolicy(epoch_batches=4,
+                                              restart_backoff=0.01))
+    from windflow_tpu.patterns.win_seq_tpu import DeviceWinSeqCore
+    assert isinstance(find_node(df, "wtpu").core, DeviceWinSeqCore)
+    install_kill_point(find_node(df, "wtpu"), 9)
+    df.run_and_wait_end(timeout=300)
+    assert got == oracle
+
+
+def test_native_core_declines_snapshot():
+    """With the native lib built (and no WF_NO_NATIVE_CORE pin) the C++
+    core declines snapshots: recovery degrades to fail-like-seed for
+    that node instead of restoring silently-wrong state."""
+    from windflow_tpu.native import load
+    if load() is None:
+        pytest.skip("native library not built")
+    from windflow_tpu.patterns.native_core import NativeResidentCore
+    from windflow_tpu.runtime.node import SnapshotUnsupported
+    got = []
+    df = _device_pipe(got, recovery=RecoveryPolicy(epoch_batches=4,
+                                                   restart_backoff=0.01))
+    node = find_node(df, "wtpu")
+    if not isinstance(node.core, NativeResidentCore):
+        pytest.skip("routing did not pick the native core here")
+    with pytest.raises(SnapshotUnsupported):
+        node.state_snapshot()
+    install_kill_point(node, 9)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        df.run_and_wait_end(timeout=300)
+
+
+def test_replay_does_not_duplicate_dead_letters():
+    """A poison batch quarantined after the last checkpoint re-raises
+    during journal replay: the budget is spent again (it was restored
+    with the snapshot) but the dead letter is NOT recorded twice."""
+    from windflow_tpu.patterns.basic import Map
+
+    batches = list(keyed_batches(n_batches=12))
+    poison_sum = int(batches[4]["id"].sum())   # content-based: replay-safe
+
+    def poison_map(batch):
+        if int(batch["id"].sum()) == poison_sum:
+            raise ValueError("poison")
+
+    m = Map(poison_map, vectorized=True, name="m")
+    m.error_budget = 1
+    got = []
+    df = Dataflow("q", capacity=8,
+                  recovery=RecoveryPolicy(epoch_batches=3,
+                                          restart_backoff=0.005))
+    build_pipeline(df, [
+        Source(batches=lambda i: iter(batches), name="src"),
+        m,
+        Sink(lambda r: got.append(1) if r is not None else None,
+             name="sink"),
+    ])
+    node = find_node(df, "m.0")
+    orig, st = node.svc, {"n": 0, "fired": False}
+
+    def svc(batch, channel=0):
+        st["n"] += 1
+        if not st["fired"] and st["n"] == 8:
+            st["fired"] = True
+            raise RuntimeError("injected crash")
+        return orig(batch, channel)
+
+    node.svc = svc
+    df.run_and_wait_end(timeout=120)
+    poison = [d for d in df.dead_letters if "poison" in str(d.error)]
+    assert len(poison) == 1, df.dead_letters
+
+
+def test_sink_not_restarted_by_default():
+    """A sink has no downstream to dedup a replay, so a sink crash fails
+    the graph even with recovery on — unless the pattern explicitly
+    opts in (idempotent sinks)."""
+
+    def build(opt_in):
+        got = []
+        sink = Sink(lambda r: got.append(r), name="sink")
+        if opt_in:
+            sink.recoverable = True   # propagated to replicas (farm.py)
+        df = Dataflow("s", capacity=8,
+                      recovery=RecoveryPolicy(epoch_batches=5,
+                                              restart_backoff=0.005))
+        build_pipeline(df, [
+            Source(batches=lambda i: keyed_batches(n_batches=10),
+                   name="src"), sink])
+        install_kill_point(find_node(df, "sink"), 4)
+        return df
+
+    with pytest.raises(RuntimeError, match="injected crash"):
+        build(opt_in=False).run_and_wait_end(timeout=120)
+    build(opt_in=True).run_and_wait_end(timeout=120)   # restarts fine
+
+
+# ------------------------------------------------------ checkpoint store
+
+
+def test_checkpoint_store_roundtrip_and_retention(tmp_path):
+    store = CheckpointStore(str(tmp_path / "ck"), retain=2)
+    for e in (1, 2, 3):
+        n = store.save_blob(e, "pipe_01_w", {"arr": np.arange(e)})
+        assert n > 0
+        store.commit(e, {"pipe_01_w": {"bytes": n}})
+    assert store.epochs() == [2, 3]          # retain=2 pruned epoch 1
+    epoch, manifest = store.latest_complete()
+    assert epoch == 3 and not manifest["partial"]
+    got = store.load(3, "pipe_01_w")
+    np.testing.assert_array_equal(got["arr"], np.arange(3))
+
+
+def test_checkpoint_store_manifest_written_last(tmp_path):
+    store = CheckpointStore(str(tmp_path / "ck"), retain=4)
+    store.save_blob(1, "n", {"x": 1})
+    # no commit yet: the epoch is invisible (a torn checkpoint can never
+    # be mistaken for a complete one)
+    assert store.epochs() == []
+    assert store.latest_complete() is None
+    store.commit(1, {"n": {"bytes": 1}})
+    assert store.epochs() == [1]
+
+
+def test_durable_checkpoints_written_by_supervisor(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    got = []
+    pol = RecoveryPolicy(epoch_batches=5, checkpoint_dir=ckdir, retain=3)
+    df = winseq_pipe(got, recovery=pol, nic=False)
+    df.run_and_wait_end(timeout=120)
+    store = CheckpointStore(ckdir, retain=3)
+    done = store.epochs()
+    assert done, "no sealed checkpoint epochs on disk"
+    epoch, manifest = store.latest_complete()
+    assert not manifest["partial"]
+    # the window worker's blob restores into a core that reproduces the
+    # remaining stream — here just prove it unpickles to the right shape
+    wid = [k for k in manifest["nodes"] if "win_seq" in k]
+    assert wid and manifest["nodes"][wid[0]]["bytes"] > 0
+    state = store.load(epoch, wid[0])
+    assert "core" in state
+
+
+def test_resolve_state_materialises_lazy_handles():
+    class Lazy:
+        def resolve(self):
+            return {"rings": (np.ones(3),), "KP": 1, "cap": 4}
+
+    out = resolve_state({"a": Lazy(), "b": [Lazy(), 2], "c": 5})
+    assert out["c"] == 5 and out["b"][1] == 2
+    np.testing.assert_array_equal(out["a"]["rings"][0], np.ones(3))
+
+
+# ------------------------------------------------------- wait() satellites
+
+
+def test_wait_timeout_bounds_hung_graph():
+    df = Dataflow("hang", capacity=4)
+    build_pipeline(df, [
+        Source(batches=lambda i: keyed_batches(n_batches=30), name="src"),
+        Sink(lambda r: time.sleep(0.2) if r is not None else None,
+             vectorized=True, name="slow"),
+    ])
+    df.run()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="still running"):
+        df.wait(timeout=0.4)
+    assert time.monotonic() - t0 < 10
+
+
+def test_multipipe_wait_timeout():
+    pipe = MultiPipe("hang2", capacity=4)
+    pipe.add_source(Source(batches=lambda i: keyed_batches(n_batches=30),
+                           name="src"))
+    pipe.add_sink(Sink(lambda r: time.sleep(0.2) if r is not None else None,
+                       vectorized=True, name="slow"))
+    pipe.run()
+    with pytest.raises(TimeoutError):
+        pipe.wait(timeout=0.4)
+
+
+def test_wait_notes_sibling_errors():
+    """Multi-node crashes: wait() raises the first error but keeps the
+    rest reachable (count + types) instead of silently dropping them."""
+    df = Dataflow("multi", capacity=0)
+
+    class Boom(Exception):
+        pass
+
+    def bang(r):
+        if r is not None:
+            raise Boom(threading.current_thread().name)
+
+    src = Source(batches=lambda i: keyed_batches(n_batches=2), name="src")
+    s1 = Sink(bang, name="s1")
+    s2 = Sink(bang, name="s2")
+    [t] = build_pipeline(df, [src])
+    for s in (s1, s2):
+        (rep,) = s.replicas()
+        df.add(rep)
+        df.connect(t, rep)
+    df.run()
+    time.sleep(0.3)   # let both sinks consume their broadcast copy
+    with pytest.raises(Boom) as ei:
+        df.wait()
+    errs = getattr(ei.value, "dataflow_errors", (ei.value,))
+    assert len(errs) == 2
+    assert all(isinstance(e, Boom) for e in errs)
+    assert ei.value.__cause__ is errs[1]
+
+
+# ------------------------------------------------------------ observability
+
+
+def test_recovery_surfaces_events_and_metrics():
+    got = []
+    pol = RecoveryPolicy(epoch_batches=5, restart_backoff=0.01)
+    df = Dataflow("obs", capacity=8, recovery=pol, metrics=True)
+    build_pipeline(df, [
+        Source(batches=lambda i: keyed_batches(), name="src"),
+        WinSeq(Reducer("sum", "value"), win_len=8, slide_len=4),
+        Sink(lambda r: got.append(1) if r is not None else None,
+             name="sink"),
+    ])
+    install_kill_point(find_node(df, "win_seq"), 9)
+    df.run_and_wait_end(timeout=120)
+    kinds = {e["event"] for e in df.events.recent}
+    assert {"epoch", "checkpoint", "node_restart", "restore"} <= kinds
+    snap = df.metrics.snapshot()
+    assert snap["counters"]["node_restarts"] == 1
+    assert snap["counters"]["node_restores"] == 1
+    assert snap["counters"]["ckpt_snapshots"] > 0
+
+
+# ------------------------------------------------------------- soak slice
+
+
+@pytest.mark.slow
+def test_soak_crash_slice():
+    """Small in-suite slice of scripts/soak_crash.py (the full soak is a
+    standalone seeded harness, docs/ROBUSTNESS.md)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "soak_crash", os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "scripts", "soak_crash.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for case in range(8):
+        mod.run_case(seed=11, case=case)

@@ -31,6 +31,7 @@ from .patterns.win_seq_tpu import (JaxWindowFunction, KeyFarmTPU,
                                    PaneFarmTPU, WinFarmTPU, WinMapReduceTPU,
                                    WinSeqTPU)
 from .obs import EventLog, MetricsRegistry
+from .recovery import CheckpointStore, EpochMarker, RecoveryPolicy
 from .runtime.node import RuntimeContext
 from .runtime.overload import DeadLetter, OverloadError, OverloadPolicy
 
@@ -58,6 +59,7 @@ __all__ = [
     "LEVEL0", "LEVEL1", "LEVEL2",
     # robustness (docs/ROBUSTNESS.md)
     "OverloadPolicy", "OverloadError", "DeadLetter",
+    "RecoveryPolicy", "CheckpointStore", "EpochMarker",
     # observability (docs/OBSERVABILITY.md)
     "MetricsRegistry", "EventLog",
 ]

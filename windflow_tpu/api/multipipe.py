@@ -86,7 +86,7 @@ class MultiPipe:
 
     def __init__(self, name: str = "pipe", trace_dir: str = None,
                  capacity: int = 16, overload=None, metrics=None,
-                 sample_period: float = None):
+                 sample_period: float = None, recovery=None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
@@ -105,6 +105,10 @@ class MultiPipe:
         #: no thread, no files, seed-identical hot paths.
         self._metrics_arg = metrics
         self.sample_period = sample_period
+        #: recovery/policy.RecoveryPolicy — epoch checkpoints + supervised
+        #: node restart for the materialised graph; None (default) keeps
+        #: seed-identical behavior (docs/ROBUSTNESS.md "Recovery")
+        self.recovery = recovery
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -283,7 +287,8 @@ class MultiPipe:
             df = Dataflow(self.name, capacity=self.capacity,
                       trace_dir=self.trace_dir, overload=self.overload,
                       metrics=self._metrics_arg,
-                      sample_period=self.sample_period)
+                      sample_period=self.sample_period,
+                      recovery=self.recovery)
             self._build_into(df)
             self._df = df
         return self._df
@@ -294,17 +299,20 @@ class MultiPipe:
         self._build().run()
         return self
 
-    def wait(self):
+    def wait(self, timeout: float = None):
+        """Join the materialised graph; ``timeout`` (seconds) bounds a
+        hung graph with a TimeoutError instead of waiting forever
+        (engine.Dataflow.wait)."""
         if self._df is None:
             raise RuntimeError("run() first")
-        self._df.wait()
+        self._df.wait(timeout=timeout)
 
-    def run_and_wait_end(self):
+    def run_and_wait_end(self, timeout: float = None):
         df = self._build()
         if df._threads:          # already started via run(): just wait
-            df.wait()
+            df.wait(timeout=timeout)
         else:
-            df.run_and_wait_end()
+            df.run_and_wait_end(timeout=timeout)
 
     @property
     def dead_letters(self):
@@ -380,6 +388,16 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                 f"cannot union MultiPipes with conflicting overload "
                 f"policies ({overload!r} vs {pol!r}): one Dataflow runs "
                 f"one policy — configure it on the merged pipe")
+    # one Dataflow runs one recovery policy: configured policies must
+    # agree (or all but one be unset), like overload policies
+    rec_pols = [p.recovery for p in pipes if p.recovery is not None]
+    recovery = rec_pols[0] if rec_pols else None
+    for pol in rec_pols[1:]:
+        if not recovery.agrees_with(pol):
+            raise ValueError(
+                f"cannot union MultiPipes with conflicting recovery "
+                f"policies ({recovery!r} vs {pol!r}): one Dataflow runs "
+                f"one policy — configure it on the merged pipe")
     # observability merges like capacity: the merged graph samples at the
     # finest requested cadence, and the first configured registry and
     # trace_dir win (these are additive sinks, not behavior — no conflict
@@ -391,6 +409,7 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                        trace_dir=trace_dirs[0] if trace_dirs else None,
                        overload=overload,
                        metrics=registries[0] if registries else None,
-                       sample_period=min(periods) if periods else None)
+                       sample_period=min(periods) if periods else None,
+                       recovery=recovery)
     merged._branches = list(pipes)
     return merged

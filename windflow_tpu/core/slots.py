@@ -71,6 +71,20 @@ class SlotMap:
         if self._on_register is not None:
             self._on_register(k)
 
+    def state_snapshot(self) -> dict:
+        """Data-only snapshot (recovery layer): the registered keys and
+        the sorted lookup view — the ``on_register`` hook is identity,
+        not state, and stays bound to the live owner on restore."""
+        return {"n": self.n, "keys": self.keys[:self.n].copy(),
+                "sorted_keys": self._sorted_keys.copy(),
+                "sorted_slots": self._sorted_slots.copy()}
+
+    def state_restore(self, snap: dict):
+        self.n = snap["n"]
+        self.keys = snap["keys"].copy()
+        self._sorted_keys = snap["sorted_keys"].copy()
+        self._sorted_slots = snap["sorted_slots"].copy()
+
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Slots for `keys` (int64 array), registering unseen keys."""
         if self.n:

@@ -129,6 +129,36 @@ def wire_service_floor_ms():
     return _WEATHER["floor_ms"]
 
 
+class RingSnapshot:
+    """Checkpoint handle over a resident ring archive (recovery layer,
+    docs/ROBUSTNESS.md "Recovery").
+
+    Grabbing one is cheap: jax arrays are functional, so holding the
+    current ring reference IS a consistent copy — each later launch
+    produces a *new* ring array and never mutates this one.  The
+    device→host transfer starts immediately (``copy_to_host_async``) but
+    materialises only at :meth:`resolve` — on the checkpoint writer
+    thread — so the copy overlaps the ring's ongoing compute instead of
+    stalling it (the CTA-pipelining hide-latency-with-stages idiom
+    applied to snapshots)."""
+
+    __slots__ = ("rings", "KP", "cap")
+
+    def __init__(self, rings, KP: int, cap: int):
+        self.rings = rings      # tuple of device arrays, or None (lazy ring)
+        self.KP = KP
+        self.cap = cap
+        if rings is not None:
+            for r in rings:
+                getattr(r, "copy_to_host_async", lambda: None)()
+
+    def resolve(self) -> dict:
+        """Materialise to host numpy (pickle-ready)."""
+        rings = (None if self.rings is None
+                 else tuple(np.asarray(r) for r in self.rings))
+        return {"rings": rings, "KP": self.KP, "cap": self.cap}
+
+
 def _pad2(a, rows, cols):
     out = np.zeros((rows, cols), dtype=a.dtype)
     out[:a.shape[0], :a.shape[1]] = a
@@ -329,6 +359,54 @@ class ResidentWindowExecutor:
                 jnp.zeros((self.KP, self.cap), dtype=self.acc_dtype),
                 self.device)
         return self._ring
+
+    # ---------------------------------------------------- checkpoint/restore
+
+    def _ring_placement(self):
+        """Where restored rings land (mesh executors override with their
+        NamedSharding)."""
+        return self.device
+
+    def _rings_tuple(self):
+        """Current ring array(s) as a tuple, or None if lazily unbuilt
+        (the multi-field executor overrides the pair of accessors; the
+        checkpoint methods below are shared)."""
+        return None if self._ring is None else (self._ring,)
+
+    def _rings_assign(self, rings):
+        self._ring = None if rings is None else rings[0]
+
+    def ring_snapshot(self) -> RingSnapshot:
+        """Consistent-copy handle of the ring(s) (caller must have
+        drained in-flight launches first — their appends are already IN
+        this ring version, but their undelivered results would be
+        lost)."""
+        if self._inflight:
+            raise RuntimeError("ring_snapshot with launches in flight; "
+                               "drain() first")
+        return RingSnapshot(self._rings_tuple(), self.KP, self.cap)
+
+    def ring_restore(self, snap):
+        """Reinstate a snapshot (RingSnapshot or its resolved dict) and
+        clear the launch queue."""
+        data = snap.resolve() if isinstance(snap, RingSnapshot) else snap
+        self._inflight.clear()
+        self._ready = []
+        self.KP = data["KP"]
+        self.cap = data["cap"]
+        rings = data["rings"]
+        self._rings_assign(None if rings is None else tuple(
+            jax.device_put(r, self._ring_placement()) for r in rings))
+
+    def invalidate(self):
+        """Drop the ring(s) and launch queue entirely: the owning
+        core's next flush rebases, rebuilding the ring from host-live
+        archive rows (the no-ring-snapshot restore path)."""
+        self._inflight.clear()
+        self._ready = []
+        self._rings_assign(None)
+        self.KP = 0
+        self.cap = 0
 
     # ------------------------------------------------------------- dispatch
 
@@ -612,6 +690,12 @@ class MultiFieldResidentExecutor(ResidentWindowExecutor):
                 for f in self.fields)
         return self._rings
 
+    def _rings_tuple(self):
+        return self._rings
+
+    def _rings_assign(self, rings):
+        self._rings = rings
+
     def narrow_for(self, field, vals: np.ndarray) -> np.dtype:
         """Per-field wire narrowing (same ladder as the base class but
         bounded by that field's ring dtype)."""
@@ -767,6 +851,9 @@ class MeshMultiFieldResidentExecutor(MultiFieldResidentExecutor):
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P(*spec))
 
+    def _ring_placement(self):
+        return self._sharding(self.axis, None)
+
     def reset(self, n_keys: int, cap: int):
         S = self.n_shards
         rows_per_shard = _bucket(max(-(-max(n_keys, 1) // S), 1))
@@ -894,6 +981,9 @@ class MeshResidentExecutor(ResidentWindowExecutor):
     def _sharding(self, *spec):
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P(*spec))
+
+    def _ring_placement(self):
+        return self._sharding(self.axis, None)
 
     def reset(self, n_keys: int, cap: int):
         S = self.n_shards

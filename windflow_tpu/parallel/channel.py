@@ -70,6 +70,7 @@ _LEN = struct.Struct("<q")
 _EOS_FRAME = -1        # clean end-of-stream (original protocol)
 _HEARTBEAT_FRAME = -2  # liveness beacon; carries no data
 _ABORT_FRAME = -3      # sender died mid-stream: NOT a clean EOS
+_EPOCH_FRAME = -4      # epoch barrier marker; 8-byte epoch payload follows
 
 
 class ChannelError(ConnectionError):
@@ -353,6 +354,24 @@ class RowSender:
                 self._tm.frames_sent.inc()
                 self._tm.bytes_sent.inc(_LEN.size + len(payload))
 
+    def send_epoch(self, epoch: int):
+        """Ship an epoch barrier control frame (recovery layer,
+        docs/ROBUSTNESS.md "Recovery"): "every row of epochs <=
+        ``epoch`` has been sent on this channel".  The receiver aligns
+        markers across all its senders (``batches(epoch_markers=True)``)
+        so multihost rows align on the same epochs as in-process edges.
+        Like every hardening knob: never sent unless the application
+        calls it, so the bytes on the wire stay seed-identical
+        otherwise."""
+        self._check_alive()
+        with self._send_lock:
+            self._sock.sendall(_LEN.pack(_EPOCH_FRAME)
+                               + _LEN.pack(int(epoch)))
+            self._last_send = time.monotonic()
+            if self._tm is not None:
+                self._tm.frames_sent.inc()
+                self._tm.bytes_sent.inc(2 * _LEN.size)
+
     def close(self):
         """Signal EOS (empty frame) and close the socket.  If the EOS
         frame cannot be delivered (peer already dead) the failure is
@@ -451,7 +470,8 @@ class RowReceiver:
                 if self.stall_timeout is not None:
                     conn.settimeout(float(self.stall_timeout))
                 self._conns.append(conn)
-                t = threading.Thread(target=self._read_loop, args=(conn,),
+                t = threading.Thread(target=self._read_loop,
+                                     args=(conn, accepted),
                                      daemon=True, name="wf-rowrecv")
                 t.start()
                 readers.append(t)
@@ -473,8 +493,8 @@ class RowReceiver:
                 # one error + one done-marker per missing sender keeps
                 # the batches() accounting exact and wakes it NOW
                 for _ in range(self.n_senders - accepted):
-                    self._q.put(failure)
-                    self._q.put(None)
+                    self._q.put((None, failure))
+                    self._q.put((None, None))
 
     def _next_frame(self, conn: socket.socket):
         """One payload frame (bytes), or None on clean EOS.  Heartbeat
@@ -494,6 +514,13 @@ class RowReceiver:
                 if tm is not None:
                     tm.heartbeats_recv.inc()
                 continue
+            if n == _EPOCH_FRAME:
+                epoch = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                if tm is not None:
+                    tm.frames_recv.inc()
+                    tm.bytes_recv.inc(2 * _LEN.size)
+                from ..recovery.epoch import EpochMarker
+                return EpochMarker(epoch)
             if n == _ABORT_FRAME:
                 if tm is not None:
                     tm.emit("peer_abort", role="receiver")
@@ -503,16 +530,24 @@ class RowReceiver:
                     "not a complete stream")
             raise ChannelError(f"bad row-channel frame length {n}")
 
-    def _read_loop(self, conn: socket.socket):
+    def _read_loop(self, conn: socket.socket, idx: int):
+        from ..recovery.epoch import EpochMarker
         try:
-            raw = self._next_frame(conn)
-            if raw is not None:
-                dtype = _decode_dtype(raw)
-                while True:
-                    raw = self._next_frame(conn)
-                    if raw is None:
-                        break
-                    self._q.put(np.frombuffer(raw, dtype=dtype).copy())
+            dtype = None
+            got_dtype = False
+            while True:
+                raw = self._next_frame(conn)
+                if raw is None:
+                    break
+                if type(raw) is EpochMarker:
+                    self._q.put((idx, raw))
+                    continue
+                if not got_dtype:
+                    # first payload frame of a connection is its dtype
+                    dtype = _decode_dtype(raw)
+                    got_dtype = True
+                    continue
+                self._q.put((idx, np.frombuffer(raw, dtype=dtype).copy()))
         except socket.timeout as e:
             stall = PeerStall(
                 f"row channel peer silent for {self.stall_timeout}s "
@@ -522,33 +557,105 @@ class RowReceiver:
             if self._tm is not None:
                 self._tm.emit("peer_stall",
                               stall_timeout=self.stall_timeout)
-            self._q.put(stall)
+            self._q.put((idx, stall))
         except Exception as e:  # noqa: BLE001 — ANY reader failure (IO,
             # undecodable dtype from a version-mismatched peer, bad frame)
             # must surface in batches(); the finally's None alone would
             # count this sender as a clean EOS and silently truncate the
             # stream — the exact failure the docstring promises to prevent
-            self._q.put(e)
+            self._q.put((idx, e))
         finally:
             conn.close()
-            self._q.put(None)   # this sender is done
+            self._q.put((idx, None))   # this sender is done
 
-    def batches(self):
+    def batches(self, epoch_markers: bool = False):
         """Yield batches until every sender has sent EOS; raises if any
         connection died mid-stream (fail fast — a silently truncated
         stream would produce silently wrong window totals).  When the
         feeding source node of a Dataflow iterates this, a raised peer
         failure lands in ``Dataflow._errors`` and ``wait()`` re-raises
-        it — remote death is a graph error, not a hang."""
+        it — remote death is a graph error, not a hang.
+
+        ``epoch_markers=True`` opts into wire epoch alignment
+        (docs/ROBUSTNESS.md "Recovery"): when every still-active sender
+        has shipped its epoch-``e`` frame (``RowSender.send_epoch``), one
+        :class:`~windflow_tpu.recovery.epoch.EpochMarker` is yielded —
+        after every row of epochs <= ``e`` from every sender, and before
+        any row of later epochs (rows from senders that run ahead are
+        held back until the barrier completes).  A recovery-enabled
+        source that re-emits the marker hands the engine an epoch
+        boundary consistent across hosts.  Alignment is tracked either
+        way; with the default ``False`` the markers are consumed
+        silently, preserving the original yield sequence."""
+        done_idx: set = set()
         done = 0
+        my_epoch = 0
+        level: dict = {}   # sender idx -> highest epoch frame seen
+        held: dict = {}    # sender idx -> [(level_at_dequeue, batch)]
+        from ..recovery.epoch import EpochMarker
+
+        def _min_level():
+            lv = [level.get(i, 0) for i in range(self.n_senders)
+                  if i not in done_idx]
+            return min(lv) if lv else None
+
         while done < self.n_senders:
-            item = self._q.get()
+            idx, item = self._q.get()
+            advanced = False
             if item is None:
                 done += 1
+                if idx is not None:
+                    done_idx.add(idx)
+                advanced = True     # a finished sender leaves the min
             elif isinstance(item, Exception):
                 raise item
+            elif type(item) is EpochMarker:
+                if item.epoch > level.get(idx, 0):
+                    level[idx] = item.epoch
+                    advanced = True
+            elif epoch_markers and level.get(idx, 0) > my_epoch:
+                # sender is past the open epoch: hold its rows until the
+                # stragglers align (content epoch = level + 1 at dequeue).
+                # Without the opt-in, frames are consumed silently and
+                # rows yield immediately — the original sequence, no
+                # unbounded buffering behind a slow straggler.
+                held.setdefault(idx, []).append((level[idx], item))
             else:
                 yield item
+            if not advanced:
+                continue
+            m = _min_level()
+            if m is None or m <= my_epoch:
+                continue
+            # barrier(s) complete through epoch m.  A row held at level
+            # L is content of epoch L+1: when the min jumps several
+            # epochs at once (a sender skipping epochs after a coarse
+            # restart), rows with L < m are content the marker claims
+            # to cover and must precede it; rows at exactly L == m open
+            # the next epoch and follow it.
+            my_epoch = m
+            for i in sorted(held):
+                keep = []
+                for lvl, row in held[i]:
+                    if lvl < m:
+                        yield row
+                    else:
+                        keep.append((lvl, row))
+                held[i] = keep
+            if epoch_markers:
+                yield EpochMarker(m)
+            for i in sorted(held):
+                keep = []
+                for lvl, row in held[i]:
+                    if lvl <= m:
+                        yield row
+                    else:
+                        keep.append((lvl, row))
+                held[i] = keep
+        # stragglers: every sender closed, release anything still held
+        for i in sorted(held):
+            for _lvl, row in held[i]:
+                yield row
 
     def close(self):
         """Tear the receiver down (failure path / tests): close the

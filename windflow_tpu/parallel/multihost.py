@@ -189,6 +189,20 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     return receiver, senders
 
 
+def ship_epoch(senders: dict, epoch: int, my_pid: int = None):
+    """Broadcast an epoch barrier frame on every outbound row channel of
+    this process's data plane (the multihost half of the recovery
+    layer's epoch alignment, docs/ROBUSTNESS.md "Recovery"): a source
+    that injects epoch ``e`` locally calls this so remote consumers'
+    ``batches(epoch_markers=True)`` aligns on the same boundary.  Call
+    it AFTER the epoch's last ``partition_and_ship`` — the frame
+    promises every row of epochs <= ``e`` is already on the wire."""
+    for pid, snd in senders.items():
+        if my_pid is not None and pid == my_pid:
+            continue
+        snd.send_epoch(epoch)
+
+
 def local_kf_groups(mesh: Mesh, process_index=None,
                     process_of=None) -> np.ndarray:
     """The kf-group indices whose device rows live on this process."""

@@ -179,6 +179,7 @@ class Source(_Pattern):
 
 class _MapNode(Node):
     shed_safe = True   # stateless operator: shedding drops stream rows
+    recoverable = True  # stateless: supervised restart needs no snapshot
     #: always true: emits either its private copy, a fresh out-schema
     #: array, or (elided path) an input batch that was itself handed off
     yields_fresh = True
@@ -242,6 +243,7 @@ class Map(_Pattern):
 
 class _FilterNode(Node):
     shed_safe = True   # stateless operator: shedding drops stream rows
+    recoverable = True  # stateless: supervised restart needs no snapshot
     #: the surviving-rows gather is a fresh allocation every time
     yields_fresh = True
 
@@ -286,6 +288,9 @@ class Filter(_Pattern):
 
 class _FlatMapNode(Node):
     shed_safe = True   # stateless operator: shedding drops stream rows
+    #: the shipper flushes per input batch, so between svc calls (where
+    #: epoch snapshots happen) there is no state to capture
+    recoverable = True
 
     def __init__(self, fn, name, rich, vectorized, out_schema, chunk):
         super().__init__(name)
@@ -337,6 +342,8 @@ class FlatMap(_Pattern):
 
 class _AccumulatorNode(Node):
     shed_safe = True   # keyed fold: shedding drops rows, no dense-id need
+    recoverable = True          # per-key fold state deep-copies cleanly
+    state_attrs = ("_keys",)    # key -> accumulator record
 
     def __init__(self, fn, init_value, result_schema, name, rich,
                  vectorized=False):
@@ -418,6 +425,12 @@ class Accumulator(_Pattern):
 
 class _SinkNode(Node):
     shed_safe = True   # terminal: shedding drops deliveries only
+    #: NOT restartable by default: a sink has no downstream to dedup the
+    #: journal replay, so a restarted sink would re-fire already-
+    #: delivered rows into the user's (possibly irreversible) side
+    #: effects.  Idempotent sinks opt in per pattern
+    #: (``sink_pattern.recoverable = True``, propagated by farm.py).
+    recoverable = False
 
     def __init__(self, fn, name, rich, vectorized):
         super().__init__(name)

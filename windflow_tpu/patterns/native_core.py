@@ -405,6 +405,23 @@ class NativeResidentCore:
 
     # ------------------------------------------------------------ streaming
 
+    # -- recovery (docs/ROBUSTNESS.md) ------------------------------------
+
+    def state_snapshot(self):
+        """The C++ core's per-key archives and window bookkeeping live in
+        native wf_core tables with no extraction API (yet): epoch
+        snapshots are unsupported here.  Pin WF_NO_NATIVE_CORE=1 to route
+        device aggregates onto the Python resident core, whose state
+        (host archives + HBM ring handle) snapshots and restores."""
+        from ..runtime.node import SnapshotUnsupported
+        raise SnapshotUnsupported(
+            "NativeResidentCore state lives in native tables "
+            "(wf_core_new) with no snapshot API; set WF_NO_NATIVE_CORE=1 "
+            "to run recoverable device cores")
+
+    def state_restore(self, snap):
+        raise RuntimeError("NativeResidentCore cannot restore snapshots")
+
     def process(self, batch: np.ndarray) -> np.ndarray:
         if self._delegate is not None:
             return self._delegate.process(batch)

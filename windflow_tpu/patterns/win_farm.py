@@ -32,6 +32,21 @@ class WFEmitterNode(Node):
 
     quarantine_exempt = True    # framework shell: errors here fail fast
     shed_safe = True            # farm head: shedding drops raw stream rows
+    #: recovery: the per-key last-tuple bookkeeping snapshots on the
+    #: numpy path; the native keymap path raises SnapshotUnsupported
+    #: (emitters.KeyedStreamState.state_snapshot)
+    recoverable = True
+
+    def state_snapshot(self):
+        snap = self._state.state_snapshot()
+        if snap is None:
+            from ..runtime.node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                f"{self.name}: native keymap state is not snapshotable")
+        return snap
+
+    def state_restore(self, snap):
+        self._state.state_restore(snap)
 
     def __init__(self, spec: WindowSpec, pardegree: int, id_outer=0, n_outer=1,
                  slide_outer=None, role: Role = Role.SEQ, name="wf_emitter"):
@@ -117,6 +132,7 @@ class WFCollectorNode(Node):
     svc calls)."""
 
     quarantine_exempt = True    # framework shell: errors here fail fast
+    recoverable = True          # reorder state is plain numpy data
 
     def __init__(self, name="wf_collector"):
         super().__init__(name)
@@ -125,6 +141,22 @@ class WFCollectorNode(Node):
         self._next = np.zeros(0, dtype=np.int64)   # slot -> next expected id
         self._pend_rows = None                     # structured array
         self._pend_slots = np.zeros(0, dtype=np.int64)
+
+    def state_snapshot(self):
+        return {
+            "slots": self._slots.state_snapshot(),
+            "next": self._next.copy(),
+            "pend_rows": (None if self._pend_rows is None
+                          else self._pend_rows.copy()),
+            "pend_slots": self._pend_slots.copy(),
+        }
+
+    def state_restore(self, snap):
+        self._slots.state_restore(snap["slots"])
+        self._next = snap["next"].copy()
+        self._pend_rows = (None if snap["pend_rows"] is None
+                           else snap["pend_rows"].copy())
+        self._pend_slots = snap["pend_slots"].copy()
 
     def _on_register(self, new_keys):
         self._next = np.concatenate(
@@ -200,6 +232,22 @@ class _OrderedWorkerNode(WinSeqNode):
         # watermark (see OrderingCore)
         self.ordering = OrderingCore(n_channels, mode,
                                      per_key_watermarks=per_key)
+
+    def state_snapshot(self):
+        merge = self.ordering.state_snapshot()
+        if merge is None:
+            from ..runtime.node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                f"{self.name}: native renumbering counters are not "
+                "snapshotable")
+        snap = super().state_snapshot()
+        snap["ordering"] = merge
+        return snap
+
+    def state_restore(self, snap):
+        self.ordering.state_restore(snap["ordering"])
+        super().state_restore({k: v for k, v in snap.items()
+                               if k != "ordering"})
 
     def svc_init(self):
         if self.n_input_channels != self.ordering.n_channels:

@@ -19,12 +19,62 @@ class WinSeqNode(Node):
     #: mutated (silently wrong windows) — never quarantine under the
     #: dataflow-wide error_budget; fail fast (runtime/overload.py)
     quarantine_exempt = True
+    #: recovery (docs/ROBUSTNESS.md): window state restores from an
+    #: epoch snapshot — host cores by whole-core deep copy (archives,
+    #: vecinc lanes, ordering buffers are all plain numpy/dict state),
+    #: device cores via their own snapshot hooks (ring archive handle +
+    #: host bookkeeping) — and supervised restart replays the journal
+    recoverable = True
 
     def __init__(self, core: WinSeqCore, name="win_seq"):
         super().__init__(name)
         self.core = core
 
+    def checkpoint_prepare(self):
+        """Device cores buffer fired windows in an async launch queue;
+        at an epoch barrier their results pre-date the snapshot cut, so
+        flush + drain them for emission first — per launch, keeping the
+        emission seq numbering independent of harvest timing (host
+        cores: no-op)."""
+        drain = getattr(self.core, "checkpoint_drain_batches", None)
+        return None if drain is None else drain()
+
+    def state_snapshot(self):
+        snap_fn = getattr(self.core, "state_snapshot", None)
+        if snap_fn is not None:
+            return snap_fn()
+        import copy
+        try:
+            return {"core": copy.deepcopy(self.core)}
+        except Exception as e:
+            # a core holding native/device handles without its own
+            # snapshot hooks cannot deep-copy — decline loudly so the
+            # supervisor degrades to fail-like-seed for this node
+            from ..runtime.node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                f"{self.name}: core {type(self.core).__name__} is not "
+                f"deep-copyable ({type(e).__name__}: {e})") from e
+
+    def state_restore(self, snap):
+        if "core" in snap:
+            import copy
+            self.core = copy.deepcopy(snap["core"])
+        else:
+            self.core.state_restore(snap)
+
     def svc(self, batch, channel=0):
+        if self._recov is not None:
+            # recovery mode + async device core: emit ONE batch per
+            # completed launch, in launch order.  Launch boundaries are
+            # count-triggered (deterministic); how many launches a given
+            # poll() harvests is wall-clock — concatenating them per svc
+            # (the seed path) would make replayed emission grouping
+            # diverge from the original run's and break the per-edge
+            # seq dedup (a split regroup would double-deliver windows).
+            pb = getattr(self.core, "process_batches", None)
+            if pb is not None:
+                self._emit_each(pb(batch), triggering=True)
+                return
         out = self.core.process(batch)
         if len(out):
             # triggering vs non-triggering split (win_seq.hpp:479-501)
@@ -35,7 +85,26 @@ class WinSeqNode(Node):
         elif self.stats is not None:
             self.stats.bump("non_triggering_batches")
 
+    def _emit_each(self, outs, triggering=False):
+        fired = 0
+        for out in outs:
+            if len(out):
+                fired += len(out)
+                self.emit(out)
+        if self.stats is not None:
+            if fired:
+                self.stats.bump("windows_fired", fired)
+                if triggering:
+                    self.stats.bump("triggering_batches")
+            elif triggering:
+                self.stats.bump("non_triggering_batches")
+
     def eosnotify(self):
+        if self._recov is not None:
+            fb = getattr(self.core, "flush_batches", None)
+            if fb is not None:
+                self._emit_each(fb())
+                return
         out = self.core.flush()
         if len(out):
             if self.stats is not None:

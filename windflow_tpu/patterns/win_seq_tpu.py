@@ -89,7 +89,49 @@ def _host_standin(winfunc):
         "restriction as the reference's __device__ functor contract)")
 
 
-class DeviceWinSeqCore(WinSeqCore):
+class _AsyncLaunchRecovery:
+    """Recovery-mode hooks shared by the async device cores
+    (docs/ROBUSTNESS.md "Recovery").  Emission granularity is ONE batch
+    per completed launch, in launch order: launch boundaries are
+    count-triggered (deterministic), while how many launches any one
+    poll()/drain() harvests is wall-clock — per-launch emission keeps a
+    replayed run's output seq numbering identical to the original's
+    regardless of harvest timing."""
+
+    def _pre_poll(self):
+        """Hook before harvesting in process_batches (the resident core
+        runs its latency-bound flush here)."""
+
+    def _per_launch(self, harvested):
+        outs = []
+        for entry in harvested:
+            built = self._build_results([entry])
+            if built:
+                outs.append(built[0] if len(built) == 1
+                            else np.concatenate(built))
+        return outs
+
+    def process_batches(self, batch):
+        """Recovery-mode process(): same work, per-launch outputs."""
+        WinSeqCore.process(self, batch)
+        self._pre_poll()
+        return self._per_launch(self.executor.poll())
+
+    def flush_batches(self):
+        WinSeqCore.flush(self)
+        self._flush_batch()
+        return self._per_launch(self.executor.drain())
+
+    def checkpoint_drain_batches(self):
+        """Epoch-barrier drain: launch the partial batch and block out
+        the in-flight results (they pre-date the snapshot cut and would
+        otherwise be lost on restore) — per launch, like every other
+        recovery-mode emission."""
+        self._flush_batch()
+        return self._per_launch(self.executor.drain())
+
+
+class DeviceWinSeqCore(_AsyncLaunchRecovery, WinSeqCore):
     """WinSeqCore whose fired-window evaluation is device-batched."""
 
     def __init__(self, spec: WindowSpec, winfunc, batch_len: int = 512,
@@ -205,6 +247,25 @@ class DeviceWinSeqCore(WinSeqCore):
             return np.zeros(0, dtype=self._result_dtype)
         return np.concatenate(outs)
 
+    # -- recovery (docs/ROBUSTNESS.md): emission hooks come from
+    # _AsyncLaunchRecovery ------------------------------------------------
+
+    def state_snapshot(self):
+        """Post-drain snapshot: the restaging executor keeps no state
+        across launches, so only the host Win_Seq bookkeeping (per-key
+        archives + counters) needs capturing."""
+        import copy
+        return {"_keys": copy.deepcopy(self._keys),
+                "_in_dtype": self._in_dtype}
+
+    def state_restore(self, snap):
+        import copy
+        self._keys = copy.deepcopy(snap["_keys"])
+        self._in_dtype = snap["_in_dtype"]
+        self._segs, self._hdr, self._pending = [], [], 0
+        self.executor._inflight.clear()
+        self.executor._ready = []
+
     def use_incremental(self):
         raise TypeError("the device path is non-incremental only "
                         "(win_seq_gpu.hpp supports NIC device functors)")
@@ -291,7 +352,7 @@ def finalize_window_values(reducer: Reducer, vals: np.ndarray,
     return vals
 
 
-class ResidentWinSeqCore(WinSeqCore):
+class ResidentWinSeqCore(_AsyncLaunchRecovery, WinSeqCore):
     """Window core whose archive lives in device HBM (ops/resident.py).
 
     Host-side it is the same Win_Seq bookkeeping as every other core; the
@@ -655,8 +716,7 @@ class ResidentWinSeqCore(WinSeqCore):
                 off += n
         return outs
 
-    def process(self, batch):
-        super().process(batch)  # fired windows are enqueued, not returned
+    def _maybe_delay_flush(self):
         if self.max_delay_s is not None and (self._wdesc or self._pend_rows):
             import time as _time
             now = _time.monotonic()
@@ -665,6 +725,10 @@ class ResidentWinSeqCore(WinSeqCore):
             elif now - self._last_flush_t >= self.max_delay_s:
                 self._flush_batch()
                 self._last_flush_t = now
+
+    def process(self, batch):
+        super().process(batch)  # fired windows are enqueued, not returned
+        self._maybe_delay_flush()
         outs = self._build_results(self.executor.poll())
         if not outs:
             return np.zeros(0, dtype=self._result_dtype)
@@ -677,6 +741,63 @@ class ResidentWinSeqCore(WinSeqCore):
         if not outs:
             return np.zeros(0, dtype=self._result_dtype)
         return np.concatenate(outs)
+
+    # -- recovery (docs/ROBUSTNESS.md): emission hooks come from
+    # _AsyncLaunchRecovery ------------------------------------------------
+
+    def _pre_poll(self):
+        self._maybe_delay_flush()
+
+    #: include the HBM ring contents in snapshots (a functional-array
+    #: handle whose device→host copy overlaps the next batches' compute,
+    #: ops/resident.RingSnapshot); the Supervisor mirrors
+    #: RecoveryPolicy.snapshot_rings here.  False = restore by forcing a
+    #: rebase from the host-live archive rows instead.
+    snapshot_rings = True
+    #: ring/cursor bookkeeping captured alongside the host archives
+    _RES_ATTRS = ("_rowmap", "_appended", "_launched", "_base")
+
+    def state_snapshot(self):
+        if self.max_delay_s is not None:
+            # the latency-bound flush is wall-clock-triggered: replayed
+            # LAUNCH boundaries would diverge from the original run's,
+            # and with them the emission seqs — decline rather than
+            # risk duplicated/lost windows after a restart
+            from ..runtime.node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                "max_delay_ms wall-clock flushes make replay emission "
+                "boundaries nondeterministic; recovery supports "
+                "count-triggered flushes only")
+        import copy
+        snap = {
+            "_keys": copy.deepcopy(self._keys),
+            "_in_dtype": self._in_dtype,
+            "resident": copy.deepcopy(
+                {a: getattr(self, a) for a in self._RES_ATTRS}),
+        }
+        if self.snapshot_rings:
+            snap["ring"] = self.executor.ring_snapshot()
+        return snap
+
+    def state_restore(self, snap):
+        import copy
+        self._keys = copy.deepcopy(snap["_keys"])
+        self._in_dtype = snap["_in_dtype"]
+        for a, v in snap["resident"].items():
+            setattr(self, a, copy.deepcopy(v))
+        self._pend_cols = {f: {} for f in self._ship_fields}
+        self._pend_rows = 0
+        self._wdesc, self._hdr, self._n_wins = [], [], 0
+        self._purge_pos = {}
+        self._last_flush_t = None
+        ring = snap.get("ring")
+        if ring is not None:
+            self.executor.ring_restore(ring)
+        else:
+            # no ring in the snapshot: invalidate so the next flush
+            # rebases — the deferred-purge invariant guarantees the
+            # host archives still hold every ring-live row
+            self.executor.invalidate()
 
     def use_incremental(self):
         raise TypeError("the device path is non-incremental only "
@@ -731,6 +852,18 @@ def _multi_resident_ok(winfunc: MultiReducer, use_pallas: bool) -> bool:
             and not any(p.op == "sum"
                         and np.issubdtype(p.dtype, np.floating)
                         for p in dev))
+
+
+def _native_core_lib():
+    """The native library handle for core routing, or None — also None
+    under WF_NO_NATIVE_CORE=1, which pins the Python resident core
+    (e.g. for recovery snapshots: the C++ core's archives live in
+    native tables with no snapshot API, docs/ROBUSTNESS.md)."""
+    import os
+    if os.environ.get("WF_NO_NATIVE_CORE", "") == "1":
+        return None
+    from ..native import enabled
+    return enabled()
 
 
 def make_device_core(worker, fn, dev_kw, index=0):
@@ -814,8 +947,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                 "needs >=1 non-count stat, ops in "
                 f"{_RESIDENT_OPS}, no float sum (got {winfunc.parts})")
         dev_parts, _pos = split_pos_max(spec, winfunc)
-        from ..native import enabled
-        _nat = enabled()
+        _nat = _native_core_lib()
         if (_nat is not None and dev_parts
                 # dev_parts empty = a fully pos-free aggregate FORCED onto
                 # the device (use_resident=True/mesh past the host route):
@@ -897,8 +1029,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                   depth=depth if depth is not None else 8,
                   compute_dtype=compute_dtype, mesh=mesh,
                   max_delay_ms=max_delay_ms)
-        from ..native import enabled
-        if enabled() is not None:
+        if _native_core_lib() is not None:
             # the C++ bookkeeping feeds the sharded ring: a real pod's
             # multi-chip path must not re-pay the Python hot loop the
             # native core was built to kill (r2 weak #3); host key-shards
@@ -914,8 +1045,7 @@ def make_core_for(spec, winfunc, *, batch_len=512, config=None,
                   depth=depth if depth is not None else 8,
                   compute_dtype=compute_dtype, worker_index=worker_index,
                   max_delay_ms=max_delay_ms)
-        from ..native import enabled
-        if enabled() is not None:
+        if _native_core_lib() is not None:
             from .native_core import NativeResidentCore
             return NativeResidentCore(spec, winfunc, shards=shards, **kw)
         return ResidentWinSeqCore(spec, winfunc, **kw)

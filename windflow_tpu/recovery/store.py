@@ -1,0 +1,140 @@
+"""Durable checkpoint store: per-node pickle blobs + an atomically renamed
+manifest per epoch, retained last-K.
+
+Layout (under ``RecoveryPolicy.checkpoint_dir``)::
+
+    epoch_000003/
+        <node_id>.ckpt      # pickle of the node's state snapshot
+        MANIFEST.json       # written LAST, via tmp + os.replace
+
+An epoch directory without a manifest is an incomplete (in-progress or
+crashed) checkpoint and is ignored by :meth:`latest_complete`.  Blobs are
+also written tmp-then-rename so a reader never observes a torn file.
+Snapshot states may contain lazy handles (e.g. the resident ring's
+device→host copy, ops/resident.RingSnapshot): :func:`resolve_state`
+materialises them just before pickling, on the supervisor's writer
+thread — which is what lets the device transfer overlap ongoing compute.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import shutil
+import time
+
+_EPOCH_DIR = re.compile(r"^epoch_(\d{6,})$")
+
+
+def _safe_id(node_id: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.+-]", "_", node_id)
+
+
+def resolve_state(state):
+    """Materialise lazy snapshot handles (objects exposing ``resolve()``)
+    into plain picklable values, recursively through dicts/lists/tuples."""
+    if hasattr(state, "resolve"):
+        return state.resolve()
+    if isinstance(state, dict):
+        return {k: resolve_state(v) for k, v in state.items()}
+    if isinstance(state, (list, tuple)):
+        out = [resolve_state(v) for v in state]
+        return out if isinstance(state, list) else tuple(out)
+    return state
+
+
+class CheckpointStore:
+    """Filesystem checkpoint store (one instance per Dataflow run, used
+    from the supervisor's writer thread only — no internal locking)."""
+
+    def __init__(self, root: str, retain: int = 2):
+        self.root = root
+        self.retain = int(retain)
+        os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------- writing
+
+    def _epoch_dir(self, epoch: int) -> str:
+        return os.path.join(self.root, f"epoch_{epoch:06d}")
+
+    def save_blob(self, epoch: int, node_id: str, state) -> int:
+        """Pickle one node's (resolved) state; returns the blob size in
+        bytes.  Raises on unpicklable state — callers degrade to
+        in-memory-only recovery for that node (checkpoint_skip event)."""
+        d = self._epoch_dir(epoch)
+        os.makedirs(d, exist_ok=True)
+        blob = pickle.dumps(resolve_state(state),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        path = os.path.join(d, f"{_safe_id(node_id)}.ckpt")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return len(blob)
+
+    def commit(self, epoch: int, nodes: dict, partial: bool = False):
+        """Seal the epoch: write the manifest (atomic rename, LAST) and
+        prune beyond the retention window.  ``nodes`` maps node_id ->
+        {"bytes": n} (or {"skipped": reason})."""
+        d = self._epoch_dir(epoch)
+        os.makedirs(d, exist_ok=True)
+        manifest = {"epoch": epoch, "t": time.time(), "partial": partial,
+                    "nodes": {_safe_id(k): v for k, v in nodes.items()}}
+        tmp = os.path.join(d, "MANIFEST.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(d, "MANIFEST.json"))
+        self._prune()
+
+    def _prune(self):
+        done = self.epochs()
+        keep_from = done[-self.retain] if len(done) >= self.retain else \
+            (done[0] if done else 0)
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return
+        for name in entries:
+            m = _EPOCH_DIR.match(name)
+            # anything older than the retention window goes — including
+            # UNSEALED directories (torn checkpoints from a crashed
+            # writer), which would otherwise accumulate forever; newer
+            # unsealed dirs are in-progress epochs and stay
+            if m and int(m.group(1)) < keep_from:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    # ------------------------------------------------------------- reading
+
+    def epochs(self) -> list:
+        """Manifested (complete) epochs, ascending."""
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in entries:
+            m = _EPOCH_DIR.match(name)
+            if m and os.path.exists(os.path.join(self.root, name,
+                                                 "MANIFEST.json")):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_complete(self):
+        """(epoch, manifest) of the newest sealed checkpoint, or None."""
+        done = self.epochs()
+        if not done:
+            return None
+        epoch = done[-1]
+        with open(os.path.join(self._epoch_dir(epoch),
+                               "MANIFEST.json")) as f:
+            return epoch, json.load(f)
+
+    def load(self, epoch: int, node_id: str):
+        """Unpickle one node's blob from a sealed epoch."""
+        path = os.path.join(self._epoch_dir(epoch),
+                            f"{_safe_id(node_id)}.ckpt")
+        with open(path, "rb") as f:
+            return pickle.load(f)

@@ -67,6 +67,44 @@ class Comb(Node):
                    if getattr(s, "error_budget", None) is not None]
         if budgets:
             self.error_budget = min(budgets)
+        #: recovery: the fused node restores stage by stage, so every
+        #: member must support snapshots — and no NON-TAIL stage may be
+        #: an async device core: its wall-clock poll() harvest cadence
+        #: shapes how many emissions leave the tail per input, so replay
+        #: could not regenerate the original seq numbering (the
+        #: per-launch discipline of _AsyncLaunchRecovery only governs a
+        #: stage the engine drives directly).  Instance attr overrides
+        #: the class default.
+        self.recoverable = (
+            all(getattr(s, "recoverable", False) for s in self.stages)
+            and not any(
+                hasattr(getattr(s, "core", None), "process_batches")
+                for s in self.stages[:-1]))
+
+    # -- recovery ----------------------------------------------------------
+
+    def checkpoint_prepare(self):
+        """Drain fused device stages in order: a mid-chain stage's
+        drained results flow synchronously through the later stages
+        (whose own drains then run after absorbing them); the last
+        stage's residue is returned for the runner to emit."""
+        tail = []
+        for i, s in enumerate(self.stages):
+            for out in (s.checkpoint_prepare() or ()):
+                if out is None or not len(out):
+                    continue
+                if i + 1 < len(self.stages):
+                    self.stages[i + 1].svc(out, 0)
+                else:
+                    tail.append(out)
+        return tail
+
+    def state_snapshot(self):
+        return [s.state_snapshot() for s in self.stages]
+
+    def state_restore(self, snap):
+        for s, part in zip(self.stages, snap):
+            s.state_restore(part)
 
     # -- lifecycle ---------------------------------------------------------
 

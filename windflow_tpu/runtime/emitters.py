@@ -211,6 +211,29 @@ class KeyedStreamState:
         keep[order] = keep_sorted
         return batch if keep.all() else batch[keep]
 
+    def state_snapshot(self):
+        """Recovery snapshot of the per-key bookkeeping, numpy path only
+        — the native keymap keeps key->slot in a C table with no
+        extraction API, so the native path returns None (the owning
+        emitter then raises SnapshotUnsupported and a crash there fails
+        the graph exactly like the seed engine)."""
+        if self._km is not None:
+            return None
+        return {
+            "slots": self._slots.state_snapshot(),
+            "last_pos": self._last_pos.copy(),
+            "rows": None if self._rows is None else self._rows.copy(),
+            "n": self._n, "cap": self._cap,
+        }
+
+    def state_restore(self, snap):
+        self._slots.state_restore(snap["slots"])
+        self._last_pos = snap["last_pos"].copy()
+        self._rows = None if snap["rows"] is None else snap["rows"].copy()
+        self._n = snap["n"]
+        self._cap = snap["cap"]
+        self.pos_cache = None
+
     def marker_batch(self) -> np.ndarray | None:
         """One marker row per key (its last tuple), for EOS replay."""
         if self._rows is None or self._n == 0:
@@ -235,6 +258,8 @@ class StandardEmitter(Node):
 
     quarantine_exempt = True    # framework shell: errors here fail fast
     shed_safe = True            # farm head: shedding drops raw stream rows
+    recoverable = True          # only the round-robin cursor is state
+    state_attrs = ("_rr",)
 
     def __init__(self, n_dest: int, routing=None, name="emitter"):
         super().__init__(name)
@@ -266,6 +291,7 @@ class Collector(Node):
     """Trivial multi-in merge (standard.hpp:91-94)."""
 
     quarantine_exempt = True    # framework shell: errors here fail fast
+    recoverable = True          # stateless pass-through merge
 
     def __init__(self, name="collector"):
         super().__init__(name)

@@ -23,8 +23,9 @@ from time import monotonic as _monotonic
 from time import perf_counter_ns as _pc_ns
 from time import sleep as _sleep
 
-from .node import Node, RuntimeContext, SourceNode
+from .node import Node, RuntimeContext, SnapshotUnsupported, SourceNode
 from .overload import DeadLetter, OverloadError, OverloadPolicy
+from ..recovery.epoch import EpochMarker, Tagged, is_ctrl_payload
 
 _EOS = object()
 
@@ -126,7 +127,11 @@ class Inbox:
                 victim = self._q.get_nowait()
             except queue.Empty:
                 continue    # consumer drained it meanwhile; retry the put
-            if victim[1] is _EOS:
+            if victim[1] is _EOS or is_ctrl_payload(victim[1]):
+                # EOS and epoch-marker control frames survive eviction
+                # (a shed marker would stall downstream barrier
+                # alignment the way a shed EOS would corrupt the
+                # per-channel EOS count)
                 self._blocking(
                     lambda: self._q.put(victim, timeout=0.05))
                 # shutdown skew: a full queue of only EOS frames would
@@ -153,6 +158,11 @@ class Inbox:
 
     def put_eos(self, src: int):
         self._blocking(lambda: self._q.put((src, _EOS), timeout=0.05))
+
+    def put_ctrl(self, src: int, item):
+        """Policy-exempt blocking put for control frames (epoch barrier
+        markers): like ``put_eos``, never shed and never deadlined."""
+        self._blocking(lambda: self._q.put((src, item), timeout=0.05))
 
     def get(self):
         return self._blocking(lambda: self._q.get(timeout=0.05))
@@ -272,7 +282,8 @@ class NativeInbox:
             if rc2 == 1:
                 continue    # consumer drained it meanwhile; retry the push
             victim = self._items.pop(vslot.value)
-            if victim is _EOS:
+            if victim is _EOS or is_ctrl_payload(victim):
+                # control frames survive eviction (see Inbox)
                 self._push(vsrc.value, victim)
                 _sleep(0.001)   # see Inbox._put_shed_oldest: no hot spin
             else:
@@ -280,6 +291,10 @@ class NativeInbox:
 
     def put_eos(self, src: int):
         self._push(src, _EOS)
+
+    def put_ctrl(self, src: int, item):
+        """Policy-exempt blocking push for control frames (see Inbox)."""
+        self._push(src, item)
 
     def get(self):
         import ctypes
@@ -316,7 +331,8 @@ class Dataflow:
 
     def __init__(self, name: str = "dataflow", capacity: int = 16,
                  trace_dir: str = None, overload: OverloadPolicy = None,
-                 metrics=None, sample_period: float = None):
+                 metrics=None, sample_period: float = None,
+                 recovery=None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
@@ -339,10 +355,21 @@ class Dataflow:
                 f"put_deadline={overload.put_deadline} needs a bounded "
                 f"inbox (capacity > 0, got {capacity}): an unbounded "
                 f"queue never sheds and never times out")
+        # `recovery` (recovery/policy.RecoveryPolicy) opts the graph into
+        # epoch checkpoints + supervised node restart (docs/ROBUSTNESS.md
+        # "Recovery"); None = seed behavior: no markers, no journals, no
+        # supervisor thread, one dead branch on the emit hot path.
+        if recovery is not None:
+            from ..recovery.policy import RecoveryPolicy
+            if not isinstance(recovery, RecoveryPolicy):
+                raise TypeError(f"recovery= wants a RecoveryPolicy, got "
+                                f"{type(recovery).__name__}")
         self.name = name
         self.capacity = capacity
         self.trace_dir = trace_dir or default_trace_dir()
         self.overload = overload
+        self.recovery = recovery
+        self._supervisor = None
         if sample_period is None:
             sample_period = default_sample_period()
         if sample_period is not None and float(sample_period) <= 0:
@@ -461,8 +488,17 @@ class Dataflow:
                             node=node.name,
                             source=isinstance(node, SourceNode))
             node.svc_init()
+            supervised = (node._recov is not None
+                          and not isinstance(node, SourceNode))
             if isinstance(node, SourceNode):
+                if node._recov is not None:
+                    # sequence-tag emissions + epoch-marker injection
+                    # (recovery/epoch.py); sources are not restartable —
+                    # a generate() failure propagates exactly as today
+                    node._recov.begin(len(node._outputs), 0, 0)
                 node.generate()
+            elif supervised:
+                self._run_supervised(node, events)
             else:
                 inbox = self._inboxes[id(node)]
                 live = inbox.n_sources
@@ -503,7 +539,11 @@ class Dataflow:
                         t0 = _pc_ns()
                         node.svc(item, src)
                         stats.record_svc(len(item), _pc_ns() - t0)
-            node.eosnotify()
+            if not supervised:
+                # the supervised loop already ran eosnotify inside its
+                # restart-protected region (a flush crash restores +
+                # replays + re-flushes)
+                node.eosnotify()
             node.svc_end()
             if node.stats is not None:
                 shed = getattr(self._inboxes[id(node)], "shed", 0)
@@ -538,10 +578,269 @@ class Dataflow:
             except _Cancelled:
                 pass
 
+    # ----------------------------------------------------------- recovery
+    # The supervised receive loop (docs/ROBUSTNESS.md "Recovery"): only
+    # entered when `recovery=` is set, so the seed loop above stays
+    # byte-identical.  Items arrive as Tagged envelopes (per-edge seq
+    # numbers, recovery/epoch.py); epoch barrier markers align across
+    # input channels Chandy-Lamport style; on alignment the node drains
+    # device queues (checkpoint_prepare), snapshots, and forwards the
+    # marker; on failure the Supervisor authorizes restore-last-snapshot
+    # + journal replay on this same thread, under the restart budget.
+
+    def _run_supervised(self, node: Node, events):
+        rec = node._recov
+        inbox = self._inboxes[id(node)]
+        rec.begin(len(node._outputs), inbox.n_sources,
+                  self._error_budget_of(node))
+        # epoch-0 snapshot: a crash before the first barrier must still
+        # have a restore point (state fresh out of svc_init)
+        self._checkpoint_node(node, rec, events, 0)
+        restoring = False
+        while True:
+            try:
+                if restoring:
+                    # inside the protected region: a deterministic fault
+                    # re-hit DURING replay burns another restart from
+                    # the budget instead of tearing the graph down
+                    restoring = False
+                    self._restore_and_replay(node, rec, events)
+                while rec.live > 0:
+                    src, item = inbox.get()
+                    if self._dispatch_supervised(node, rec, events, src,
+                                                 item):
+                        self._complete_barriers(node, rec, events)
+                node.eosnotify()
+                return
+            except (_Cancelled, OverloadError):
+                # graph failed elsewhere / backpressure deadline: both
+                # must fail exactly like the seed engine (a restart
+                # would re-block on the same saturated downstream)
+                raise
+            except Exception as e:
+                if not self._supervisor.authorize_restart(node, rec, e):
+                    raise
+                restoring = True
+
+    def _dispatch_supervised(self, node: Node, rec, events, src, item,
+                             lvl: int = None) -> bool:
+        """Handle one inbox item; True when barrier alignment may have
+        advanced (the caller then completes any ready barriers — kept
+        out of this function so a held-item drain can't checkpoint
+        mid-iteration).  ``lvl`` is the item's channel epoch level at
+        ARRIVAL: None for a fresh inbox item (the current level), an
+        explicit value when replaying from the journal — replay must
+        repeat the original hold-or-process decisions, and the restored
+        ``chan_epoch`` only knows the commit-time (possibly later)
+        level."""
+        if item is _EOS:
+            if lvl is None:
+                lvl = rec.chan_epoch.get(src, 0)
+            rec.journal_append(src, item, lvl)
+            if lvl > rec.epoch:
+                # the channel ran ahead of the node's epoch and its data
+                # is held back — processing its EOS now would lift
+                # order-sensitive consumers' watermarks past the held
+                # rows, so the EOS waits its turn in arrival order
+                rec.held.append((src, item, lvl))
+                return False
+            rec.live -= 1
+            rec.eos.add(src)
+            node.on_channel_eos(src)
+            if events is not None:
+                events.emit("eos", dataflow=self.name, node=node.name,
+                            channel=src, live=rec.live)
+            return True
+        if type(item) is Tagged:
+            seq, payload = item.seq, item.payload
+            stale = seq <= rec.last_seen.get(src, -1)
+        else:
+            payload = item
+            stale = False
+        if type(payload) is EpochMarker:
+            # markers apply EVEN when their seq is stale: a shed_oldest
+            # eviction re-queues a marker at the inbox tail, behind
+            # later same-channel seqs — dropping it as a duplicate
+            # would stall barrier alignment forever.  The update is a
+            # monotone max, so re-applying a truly replayed marker is
+            # harmless.
+            if not stale:
+                rec.journal_append(src, item, 0)
+                if type(item) is Tagged:
+                    rec.last_seen[src] = item.seq
+            if payload.epoch > rec.chan_epoch.get(src, 0):
+                rec.chan_epoch[src] = payload.epoch
+            return True
+        if stale:
+            return False            # duplicate from a restarted producer
+        if lvl is None:
+            lvl = rec.chan_epoch.get(src, 0)
+        rec.journal_append(src, item, lvl)
+        if type(item) is Tagged:
+            rec.last_seen[src] = item.seq
+        if lvl > rec.epoch:
+            # this channel is past the node's epoch: hold its data back
+            # until the barrier completes, so the snapshot is a
+            # consistent cut.  ``lvl`` pins the item's content epoch
+            # (lvl+1) — the barrier drain orders by it, since the
+            # channel's CURRENT epoch may advance further meanwhile.
+            rec.held.append((src, item, lvl))
+            return False
+        self._svc_supervised(node, rec, src, payload)
+        return False
+
+    def _apply_held(self, node: Node, rec, events, src, item):
+        """Process one held-back item: already deduped and journaled on
+        first receipt, and its turn has come — no further checks."""
+        if item is _EOS:
+            rec.live -= 1
+            rec.eos.add(src)
+            node.on_channel_eos(src)
+            if events is not None:
+                events.emit("eos", dataflow=self.name, node=node.name,
+                            channel=src, live=rec.live)
+            return
+        payload = item.payload if type(item) is Tagged else item
+        self._svc_supervised(node, rec, src, payload)
+
+    def _svc_supervised(self, node: Node, rec, src, payload):
+        """svc + stats + poison-tuple quarantine, mirroring the seed
+        loop; budget lives on the recovery record so restarts restore
+        it with the snapshot."""
+        stats = node.stats
+        if rec.budget > 0:
+            try:
+                if stats is None:
+                    node.svc(payload, src)
+                else:
+                    t0 = _pc_ns()
+                    node.svc(payload, src)
+                    stats.record_svc(len(payload), _pc_ns() - t0)
+            except OverloadError:
+                raise
+            except Exception as e:
+                rec.budget -= 1
+                if rec.requarantine_skip > 0:
+                    # journal replay re-raising on an already-
+                    # quarantined batch: spend the budget again (the
+                    # snapshot restored it) but don't duplicate the
+                    # dead letter / event the original pass recorded
+                    rec.requarantine_skip -= 1
+                else:
+                    rec.quarantined += 1
+                    self._quarantine(node, payload, src, e)
+        elif stats is None:
+            node.svc(payload, src)
+        else:
+            t0 = _pc_ns()
+            node.svc(payload, src)
+            stats.record_svc(len(payload), _pc_ns() - t0)
+
+    def _complete_barriers(self, node: Node, rec, events):
+        while True:
+            epoch = rec.barrier_ready()
+            if epoch is None:
+                return
+            if epoch == "eos":
+                # every channel reached EOS: no further barrier can
+                # complete, so the remaining held items process now, in
+                # arrival order, ahead of the EOS flush (EOS aligns a
+                # channel to every epoch)
+                rec.epoch = max(rec.chan_epoch.values(),
+                                default=rec.epoch)
+                pending, rec.held = rec.held, []
+                for src, item, _lvl in pending:
+                    self._apply_held(node, rec, events, src, item)
+                continue
+            # a held item at level L is content of epoch L+1.  When the
+            # barrier min jumps several epochs at once (a lagging
+            # channel EOSing, wire sources skipping epochs), items with
+            # L < epoch are content the epoch-`epoch` snapshot claims to
+            # cover — they process BEFORE it; items at exactly L ==
+            # epoch open the next epoch and process after the marker.
+            early = [(s, i) for s, i, l in rec.held if l < epoch]
+            # keep the still-unprocessed items in rec.held through the
+            # checkpoint: commit() journals exactly this set
+            rec.held = [(s, i, l) for s, i, l in rec.held if l >= epoch]
+            for src, item in early:
+                self._apply_held(node, rec, events, src, item)
+            self._checkpoint_node(node, rec, events, epoch)
+            if events is not None:
+                events.emit("epoch", dataflow=self.name,
+                            node=node.name, epoch=epoch)
+            now = [(s, i) for s, i, l in rec.held if l <= epoch]
+            rec.held = [(s, i, l) for s, i, l in rec.held if l > epoch]
+            for src, item in now:
+                self._apply_held(node, rec, events, src, item)
+
+    def _checkpoint_node(self, node: Node, rec, events, epoch: int):
+        """Snapshot one node at a completed barrier: drain async device
+        work (its results pre-date the barrier), snapshot state, commit
+        in-memory, and hand the blob to the supervisor's writer."""
+        t0 = _monotonic()
+        for out in (node.checkpoint_prepare() or ()):
+            if out is not None and len(out):
+                node.emit(out)
+        if epoch > 0:
+            # forward the barrier BEFORE committing, so the snapshot's
+            # output sequence counters include the marker — a restored
+            # node's first re-emission must not collide with the
+            # marker's seq (downstream would drop it as a duplicate)
+            rec.forward_marker(node._outputs, epoch)
+        if not rec.journaling:
+            # non-snapshotable node: just track the epoch so held-back
+            # items and marker forwarding stay aligned
+            rec.epoch = epoch
+            return
+        try:
+            state = node.state_snapshot()
+        except SnapshotUnsupported as e:
+            rec.mark_unrecoverable(str(e) or type(e).__name__)
+            rec.epoch = epoch
+            return
+        rec.commit(epoch, state)
+        self._supervisor.note_checkpoint(node, rec, epoch,
+                                         _monotonic() - t0)
+        self._supervisor.enqueue_blob(rec, epoch, state)
+
+    def _restore_and_replay(self, node: Node, rec, events):
+        t0 = _monotonic()
+        node_state, todo = rec.restore()
+        replayed = -1      # -1: state_restore itself not yet done
+        try:
+            node.state_restore(node_state)
+            replayed = 0
+            for src, item, lvl in todo:
+                if self._dispatch_supervised(node, rec, events, src, item,
+                                             lvl=lvl):
+                    self._complete_barriers(node, rec, events)
+                replayed += 1
+        except BaseException:
+            # a fault re-hit mid-replay: the crashing item is already
+            # back in the journal (dispatch appends before handling) —
+            # re-attach the unreplayed tail so the NEXT restore still
+            # sees the full post-snapshot input sequence.  A failure in
+            # state_restore itself (replayed == -1) re-attaches ALL of
+            # it: nothing was consumed yet.
+            rec.journal.extend(todo[replayed + 1:] if replayed >= 0
+                               else todo)
+            raise
+        # a transient original fault may not re-raise on replay:
+        # leftover skips must never swallow a future real quarantine
+        rec.requarantine_skip = 0
+        self._supervisor.note_restored(node, rec, len(todo),
+                                       _monotonic() - t0)
+
+    # ---------------------------------------------------------------- run
+
     def run(self):
         if self._threads:
             raise RuntimeError(
                 f"Dataflow {self.name!r} already started; a graph runs once")
+        if self.recovery is not None and self._supervisor is None:
+            from ..recovery.supervisor import Supervisor
+            self._supervisor = Supervisor(self, self.recovery)
+            self._supervisor.attach_all()
         if self.events is not None:
             self.events.emit("dataflow_start", dataflow=self.name,
                              nodes=len(self.nodes),
@@ -556,26 +855,76 @@ class Dataflow:
             self._sampler = Sampler(self, self.sample_period)
             self._sampler.start()
 
-    def wait(self):
+    def wait(self, timeout: float = None):
+        """Join every node thread and re-raise the first node error.
+
+        ``timeout`` (seconds, None = wait forever) bounds a hung graph:
+        on expiry the graph is cancelled (failure flag + inbox wakeups,
+        so blocked threads exit) and :class:`TimeoutError` is raised
+        naming the still-running nodes — for soaks and CI, a loud bound
+        instead of a suite-level kill.
+
+        When several nodes failed, the first error is raised with the
+        second chained as its ``__cause__`` and the full tuple attached
+        as ``error.dataflow_errors`` — multi-node crashes stay
+        diagnosable instead of silently dropping all but one."""
+        timed_out = False
         try:
-            for t in self._threads:
-                t.join()
+            if timeout is None:
+                for t in self._threads:
+                    t.join()
+            else:
+                t_end = _monotonic() + float(timeout)
+                for t in self._threads:
+                    t.join(max(t_end - _monotonic(), 0.0))
+                    if t.is_alive():
+                        timed_out = True
+                        break
+                if timed_out:
+                    # unblock everything, then a short grace to exit
+                    self._failed.set()
+                    for inbox in self._inboxes.values():
+                        inbox.cancel()
+                    for t in self._threads:
+                        t.join(timeout=1.0)
         finally:
             if self._sampler is not None:
                 self._sampler.stop()   # takes the final flush sample
                 self._sampler = None
+            if self._supervisor is not None:
+                # flush pending checkpoint blobs — briefly on the
+                # timeout path, so wait(timeout=) keeps its bound
+                self._supervisor.stop(wait_s=1.0 if timed_out else 30.0)
             if self.events is not None and not self._stop_logged:
                 self._stop_logged = True
                 self.events.emit("dataflow_stop", dataflow=self.name,
                                  errors=len(self._errors),
                                  dead_letters=len(self.dead_letters))
                 self.events.close()
+        if timed_out:
+            alive = [t.name for t in self._threads if t.is_alive()]
+            err = TimeoutError(
+                f"Dataflow {self.name!r} still running after {timeout}s "
+                f"(alive: {alive or 'draining'}); graph cancelled")
+            if self._errors:
+                # a node failure often CAUSES the hang (a sibling stuck
+                # in user code past the cancel): keep the root cause
+                # visible instead of masking it with the timeout
+                err.dataflow_errors = tuple(self._errors)
+                raise err from self._errors[0]
+            raise err
         if self._errors:
-            raise self._errors[0]
+            first = self._errors[0]
+            rest = [e for e in self._errors[1:] if e is not first]
+            if rest:
+                first.dataflow_errors = tuple(self._errors)
+                if first.__cause__ is None and first.__context__ is None:
+                    first.__cause__ = rest[0]
+            raise first
 
-    def run_and_wait_end(self):
+    def run_and_wait_end(self, timeout: float = None):
         self.run()
-        self.wait()
+        self.wait(timeout=timeout)
 
     def cardinality(self) -> int:
         """Number of execution threads (multipipe.hpp:973)."""

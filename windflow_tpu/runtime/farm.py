@@ -15,14 +15,26 @@ DEFAULT = object()
 
 
 def _apply_error_budget(pattern, replicas: list[Node]) -> list[Node]:
-    """Propagate a pattern-level poison-tuple budget (builders'
-    withErrorBudget) onto the worker nodes the engine actually runs —
-    shell nodes (emitter/collector) keep fail-fast semantics: an error
-    there is a framework bug, not a poison tuple."""
+    """Propagate per-node policy knobs a pattern carries onto the worker
+    nodes the engine actually runs — shell nodes (emitter/collector)
+    keep their class defaults:
+
+    * ``error_budget`` (builders' withErrorBudget): poison-tuple
+      quarantine allowance — an error in a shell is a framework bug,
+      not a poison tuple, so shells never inherit it;
+    * ``recoverable`` (a pattern attribute, default absent): an explicit
+      False opts the pattern's workers out of supervised restart
+      (docs/ROBUSTNESS.md "Recovery") — e.g. a sink with irreversible
+      external side effects where replayed emissions must not re-fire.
+    """
     budget = getattr(pattern, "error_budget", None)
     if budget is not None:
         for r in replicas:
             r.error_budget = int(budget)
+    recover = getattr(pattern, "recoverable", None)
+    if recover is not None:
+        for r in replicas:
+            r.recoverable = bool(recover)
     return replicas
 
 

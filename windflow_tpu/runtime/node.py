@@ -18,6 +18,13 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 
+class SnapshotUnsupported(RuntimeError):
+    """This node cannot produce a state snapshot in its current
+    configuration (e.g. state held in native-library tables with no
+    extraction API) — the recovery layer marks it non-restartable and a
+    failure there tears the graph down exactly like the seed engine."""
+
+
 class RuntimeContext:
     """Execution context handed to "rich" user functions
     (reference context.hpp:45-80): the replica's parallelism degree and
@@ -85,6 +92,21 @@ class Node:
     #: edges keep blocking and the backpressure propagates to the
     #: nearest shed-safe inbox upstream.
     shed_safe = False
+    #: recovery layer (docs/ROBUSTNESS.md "Recovery"): True on node
+    #: classes whose state the supervised-restart path can snapshot and
+    #: restore (stateless operators trivially; window cores via their
+    #: core's deep copy / device hooks).  False (default) means a crash
+    #: here fails the graph exactly like the seed engine even when
+    #: ``recovery=`` is on.
+    recoverable = False
+    #: instance attributes carrying mutable stream state — the default
+    #: ``state_snapshot`` deep-copies exactly these (empty = stateless)
+    state_attrs = ()
+    #: per-node recovery record (recovery/epoch.NodeRecovery), installed
+    #: by the Supervisor when the dataflow opts in; None (the class
+    #: default) keeps emit()/emit_to() on the seed path — the single
+    #: dead branch the recovery contract allows on the hot path
+    _recov = None
 
     def __init__(self, name: str = None):
         self.name = name or type(self).__name__
@@ -112,6 +134,34 @@ class Node:
     def svc_end(self):
         """Called after eosnotify, before the thread exits."""
 
+    # -- recovery hooks ----------------------------------------------------
+    def checkpoint_prepare(self):
+        """Called at epoch-barrier alignment before ``state_snapshot``:
+        drain any in-flight async work whose results are not yet part of
+        this node's state (device launch queues) and return the output
+        batches to emit — one per launch, in launch order, so replayed
+        emission numbering stays deterministic (None/empty: nothing to
+        drain)."""
+        return None
+
+    def state_snapshot(self):
+        """Snapshot this node's mutable state (any deep-copied/immutable
+        object; None for stateless).  Raise :class:`SnapshotUnsupported`
+        when the current configuration cannot snapshot."""
+        if not self.state_attrs:
+            return None
+        import copy
+        return {a: copy.deepcopy(getattr(self, a))
+                for a in self.state_attrs}
+
+    def state_restore(self, snap):
+        """Reset state to a ``state_snapshot`` value.  The snapshot must
+        survive repeated restores, so mutable state is copied back in."""
+        if snap:
+            import copy
+            for a, v in snap.items():
+                setattr(self, a, copy.deepcopy(v))
+
     # -- emission ----------------------------------------------------------
     def emit(self, batch):
         """Send to every output channel (broadcast for 1 output; nodes with
@@ -120,6 +170,11 @@ class Node:
             return
         if self.stats is not None:
             self.stats.record_departure()
+        if self._recov is not None:
+            # recovery layer on: sequence-tag the emission per edge (and
+            # let sources trail epoch markers) — recovery/epoch.py
+            self._recov.emit(self._outputs, batch)
+            return
         for inbox, src in self._outputs:
             inbox.put(src, batch)
 
@@ -129,6 +184,9 @@ class Node:
             return
         if self.stats is not None:
             self.stats.record_departure()
+        if self._recov is not None:
+            self._recov.emit_to(self._outputs, out, batch)
+            return
         inbox, src = self._outputs[out]
         inbox.put(src, batch)
 

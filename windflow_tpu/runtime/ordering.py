@@ -120,6 +120,37 @@ class OrderingCore:
             self._renum_lib.wf_renum_free(self._renum)
             self._renum = None
 
+    def state_snapshot(self):
+        """Recovery snapshot of the merge state (buffered chunks,
+        watermarks, renumbering counters).  Returns None when the native
+        per-key renumbering table is active — its counters live in a C
+        table with no extraction API, so the owning node reports
+        SnapshotUnsupported and a crash there fails as in the seed
+        engine (snapshots taken *before* the table's lazy creation are
+        fine: a fresh table equals the all-zero counter state)."""
+        if self._renum is not None:
+            return None
+        import copy
+        return {
+            "keys": copy.deepcopy(self._keys),
+            "eos": self._eos.copy(),
+            "watermark": self.watermark.copy(),
+            "released_upto": self._released_upto,
+        }
+
+    def state_restore(self, snap):
+        import copy
+        self._keys = copy.deepcopy(snap["keys"])
+        self._eos = snap["eos"].copy()
+        self.watermark = snap["watermark"].copy()
+        self._released_upto = snap["released_upto"]
+        if self._renum is not None:
+            # table created after the snapshot was taken — the snapshot
+            # predates every fast-path push, so all counters were zero:
+            # a fresh table (lazily recreated on the next push) matches
+            self._renum_lib.wf_renum_free(self._renum)
+            self._renum = None
+
     def _buf(self, key):
         b = self._keys.get(key)
         if b is None:
@@ -335,6 +366,10 @@ class OrderingNode(Node):
     #: framework merge, not user code: a dropped batch here would
     #: silently corrupt the ordered stream — always fail fast
     quarantine_exempt = True
+    #: recovery: merge buffers + watermarks snapshot as plain data (the
+    #: native renumbering table is the one dynamic exception, see
+    #: OrderingCore.state_snapshot)
+    recoverable = True
 
     def __init__(self, n_channels: int, mode: OrderingMode, name="ordering",
                  ordered_input: bool = False, owned_input: bool = False):
@@ -342,6 +377,18 @@ class OrderingNode(Node):
         self.core = OrderingCore(n_channels, mode,
                                  ordered_input=ordered_input,
                                  owned_input=owned_input)
+
+    def state_snapshot(self):
+        snap = self.core.state_snapshot()
+        if snap is None:
+            from .node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                f"{self.name}: native renumbering counters are not "
+                "snapshotable")
+        return snap
+
+    def state_restore(self, snap):
+        self.core.state_restore(snap)
 
     def svc(self, batch, channel=0):
         for out in self.core.push(batch, channel):
