@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""wf-lint — stand-alone static analysis for windflow_tpu graphs
+(docs/CHECKS.md).
+
+Imports one or more app modules, collects their dataflow graphs, runs
+the ``windflow_tpu/check`` validator, and prints each diagnostic with a
+``file:line`` anchor when one is known:
+
+    python scripts/wf_lint.py windflow_tpu.apps.ysb windflow_tpu.apps.pipe
+    python scripts/wf_lint.py path/to/my_app.py --error
+
+Graph discovery, per module:
+
+* a callable ``wf_check_pipelines()`` (the convention the bundled bench
+  apps follow) — returns an iterable of ``MultiPipe``/``Dataflow``/
+  ``WireConfig`` objects to validate;
+* otherwise, module-level attributes that already ARE such objects.
+
+Exit status: 0 when clean (or diagnostics are informational), 1 under
+``--error`` when any non-suppressed diagnostic was reported, 2 on usage
+or import failure.  ``# wf-lint: disable=WF###`` on the anchored source
+line suppresses a diagnostic (``--show-suppressed`` lists them anyway).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_module(spec: str):
+    """Import ``spec`` — a dotted module name or a path to a .py file."""
+    if spec.endswith(".py") or os.path.sep in spec:
+        path = os.path.abspath(spec)
+        name = os.path.splitext(os.path.basename(path))[0]
+        mspec = importlib.util.spec_from_file_location(name, path)
+        if mspec is None:
+            raise ImportError(f"cannot load {spec!r}")
+        mod = importlib.util.module_from_spec(mspec)
+        sys.modules.setdefault(name, mod)
+        mspec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(spec)
+
+
+def collect_targets(mod):
+    """Validation targets of one module (see module docstring)."""
+    hook = getattr(mod, "wf_check_pipelines", None)
+    if callable(hook):
+        targets = list(hook())
+    else:
+        targets = []
+        for name in sorted(vars(mod)):
+            obj = getattr(mod, name)
+            cls = type(obj).__name__
+            if cls in ("MultiPipe", "Dataflow", "WireConfig"):
+                targets.append(obj)
+    return targets
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wf_lint", description="static analysis for windflow_tpu "
+        "graphs (docs/CHECKS.md)")
+    ap.add_argument("modules", nargs="+",
+                    help="dotted module names or .py paths to lint")
+    ap.add_argument("--error", action="store_true",
+                    help="exit 1 when any diagnostic is reported")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print wf-lint:disable'd diagnostics")
+    args = ap.parse_args(argv)
+
+    from windflow_tpu.check import validate
+
+    n_diags = n_targets = 0
+    for spec in args.modules:
+        try:
+            mod = load_module(spec)
+        except Exception as e:
+            print(f"{spec}: import failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        targets = collect_targets(mod)
+        if not targets:
+            print(f"{spec}: no dataflow graphs found (define "
+                  f"wf_check_pipelines() or module-level MultiPipe/"
+                  f"Dataflow/WireConfig objects)", file=sys.stderr)
+            continue
+        for target in targets:
+            n_targets += 1
+            tname = getattr(target, "name", type(target).__name__)
+            report = validate(target)
+            for d in report:
+                n_diags += 1
+                print(f"{d.where()}: {d.code} {d.severity}: {d.message}")
+            if args.show_suppressed:
+                for d in report.suppressed:
+                    print(f"{d.where()}: {d.code} suppressed: "
+                          f"{d.message}")
+            if not len(report):
+                print(f"{spec}:{tname}: OK")
+    print(f"wf-lint: {n_targets} graph(s), {n_diags} diagnostic(s)")
+    if args.error and n_diags:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

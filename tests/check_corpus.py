@@ -1,0 +1,84 @@
+"""Seeded misconfiguration corpus for ``scripts/wf_lint.py`` (ISSUE 11
+acceptance): every graph/config here plants at least one specific WF###
+diagnostic, and ``tests/test_check.py::test_wf_lint_cli_corpus`` asserts
+the CLI reports each of ``PLANTED``.
+
+Not a test module itself — imported by wf_lint via the
+``wf_check_pipelines()`` convention (and as a module-level ``WireConfig``
+scan target).
+"""
+
+import numpy as np
+
+from windflow_tpu.api import MultiPipe
+from windflow_tpu.core.tuples import Schema
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.parallel.channel import WireConfig
+from windflow_tpu.patterns.basic import Sink, Source, Map
+from windflow_tpu.patterns.pane_farm import PaneFarm
+from windflow_tpu.patterns.win_seq import WinSeq
+from windflow_tpu.runtime.overload import OverloadPolicy
+
+SCHEMA = Schema(value=np.int64)
+
+#: WF### ids the CLI run over this module must report
+PLANTED = ("WF102", "WF103", "WF204", "WF205", "WF207", "WF208",
+           "WF301")
+
+#: module-level scan target: heartbeat at/above the stall timeout
+BAD_WIRE = WireConfig(heartbeat=5.0, stall_timeout=2.0)   # -> WF205
+
+
+def _red(key, gwid, rows):
+    return {"value": rows["value"].sum()}
+
+
+def _src(shipper):
+    return None
+
+
+def _window_pipe() -> MultiPipe:
+    """WF102 (hopping gap) + WF103 (non-divisible pane factor) +
+    WF207 (metrics with no trace_dir)."""
+    return (MultiPipe("corpus_windows", metrics=True)
+            .add_source(Source(_src, SCHEMA))
+            .add(WinSeq(_red, 4, 8, WinType.CB,
+                        result_fields={"value": np.int64}))
+            .add(PaneFarm(_red, _red, 10, 3, WinType.CB,
+                          plq_result_fields={"value": np.int64},
+                          wlq_result_fields={"value": np.int64}))
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+
+
+def _overload_pipe() -> MultiPipe:
+    """WF208: shedding policy on unbounded inboxes (never builds)."""
+    return (MultiPipe("corpus_overload", capacity=0,
+                      overload=OverloadPolicy(shed="shed_newest"))
+            .add_source(Source(_src, SCHEMA))
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+
+
+def _recovery_pipe() -> MultiPipe:
+    """WF204: recovery over a sink that never opted into restart."""
+    from windflow_tpu.recovery.policy import RecoveryPolicy
+    return (MultiPipe("corpus_recovery", recovery=RecoveryPolicy())
+            .add_source(Source(_src, SCHEMA))
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+
+
+def _race_pipe() -> MultiPipe:
+    """WF301: parallel replicas mutating closed-over shared state."""
+    counts = [0]
+
+    def bump(batch):
+        counts[0] += len(batch)
+
+    return (MultiPipe("corpus_race")
+            .add_source(Source(_src, SCHEMA))
+            .add(Map(bump, parallelism=2, vectorized=True))
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+
+
+def wf_check_pipelines():
+    return [_window_pipe(), _overload_pipe(), _recovery_pipe(),
+            _race_pipe(), BAD_WIRE]

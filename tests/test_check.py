@@ -1,0 +1,505 @@
+"""Static graph validation (windflow_tpu/check/, docs/CHECKS.md):
+
+* a parametrized corpus where every WF### id has a minimal failing
+  graph AND a minimally-fixed twin that must validate clean;
+* the ``check=`` knob contract: unset never imports the package,
+  'warn' reports CheckWarnings and still runs, 'error' raises
+  CheckError before any thread starts (WF id + node_stats_name in the
+  message), union merges by strictness;
+* suppression directives (``# wf-lint: disable=WF###``) and the
+  closure analyzer's lock heuristic;
+* the tier-1 self-lint: the four bench apps validate diagnostic-free;
+* the ``scripts/wf_lint.py`` CLI over the seeded misconfig corpus
+  (tests/check_corpus.py) and over the bench apps.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from windflow_tpu.api import MultiPipe, union_multipipes
+from windflow_tpu.check import CheckError, CheckWarning, validate
+from windflow_tpu.core.tuples import Schema
+from windflow_tpu.core.windows import WindowSpec, WinType
+from windflow_tpu.parallel.channel import WireConfig
+from windflow_tpu.patterns.basic import (Map, Sink, Source,
+                                         _AccumulatorNode)
+from windflow_tpu.patterns.pane_farm import PaneFarm
+from windflow_tpu.patterns.win_seq import WinSeq, WinSeqNode
+from windflow_tpu.recovery.policy import RecoveryPolicy
+from windflow_tpu.runtime.emitters import StandardEmitter, default_routing
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.overload import OverloadPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = Schema(value=np.int64)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_obs_env(monkeypatch):
+    """The corpus pins exact diagnostic sets: an ambient WF_LOG_DIR
+    would silence WF207, an ambient WF_SAMPLE_PERIOD would plant it
+    everywhere."""
+    monkeypatch.delenv("WF_LOG_DIR", raising=False)
+    monkeypatch.delenv("WF_SAMPLE_PERIOD", raising=False)
+
+
+def _src(shipper):
+    return None
+
+
+def _red(key, gwid, rows):
+    return {"value": rows["value"].sum()}
+
+
+def _win_fields():
+    return {"value": np.int64}
+
+
+def _sink():
+    return Sink(lambda b: None, vectorized=True)
+
+
+def _pipe(*patterns, **kw):
+    p = MultiPipe(kw.pop("name", "chk"), **kw)
+    p.add_source(Source(_src, SCHEMA))
+    for pat in patterns:
+        p.add(pat)
+    p.add_sink(_sink())
+    return p
+
+
+# ------------------------------------------------------- stub cores
+
+class _StubHostCore:
+    """Deep-copyable stand-in for a host window core."""
+    spec = WindowSpec(4, 2, WinType.CB)
+
+
+class NativeResidentCore:
+    """Stub matching the WF201 duck-type probe (class name), so the
+    corpus runs with or without the native .so."""
+    spec = WindowSpec(4, 2, WinType.CB)
+
+
+class _StubAsyncCore:
+    """Async device core shape: process_batches + max_delay_s."""
+    spec = WindowSpec(4, 2, WinType.CB)
+    max_delay_s = None
+
+    def process_batches(self, batch):
+        return []
+
+
+def _acc_node(name):
+    return _AccumulatorNode(lambda row, acc: None, None, SCHEMA, name,
+                            rich=False)
+
+
+def _routing_df(routing):
+    df = Dataflow("route")
+    em = df.add(StandardEmitter(2, routing, name="em"))
+    a = df.add(_acc_node("acc.0"))
+    b = df.add(_acc_node("acc.1"))
+    df.connect(em, a)
+    df.connect(em, b)
+    return df
+
+
+def _native_df():
+    df = Dataflow("nat", recovery=RecoveryPolicy())
+    df.add(WinSeqNode(NativeResidentCore(), name="agg.0"))
+    return df
+
+
+def _host_df():
+    df = Dataflow("nat", recovery=RecoveryPolicy())
+    df.add(WinSeqNode(_StubHostCore(), name="agg.0"))
+    return df
+
+
+def _async_df(max_delay):
+    core = _StubAsyncCore()
+    core.max_delay_s = max_delay
+    df = Dataflow("dev", recovery=RecoveryPolicy())
+    df.add(WinSeqNode(core, name="agg.0"))
+    return df
+
+
+def _comb_df(async_first):
+    from windflow_tpu.runtime.comb import make_comb
+    from windflow_tpu.patterns.basic import _MapNode
+    win = WinSeqNode(_StubAsyncCore(), name="agg.0")
+    mp = _MapNode(lambda b: None, "map.0", False, True, None)
+    stages = [win, mp] if async_first else [mp, win]
+    df = Dataflow("comb", recovery=RecoveryPolicy())
+    df.add(make_comb(stages, name="chain.0"))
+    return df
+
+
+def _recovery_sink_pipe(opt_in):
+    s = _sink()
+    if opt_in:
+        s.recoverable = True
+    p = MultiPipe("recsink", recovery=RecoveryPolicy())
+    p.add_source(Source(_src, SCHEMA))
+    p.add_sink(s)
+    return p
+
+
+def _race_pipe(guarded):
+    counts = [0]
+    lock = threading.Lock()
+
+    if guarded:
+        def bump(batch):
+            with lock:
+                counts[0] += len(batch)
+    else:
+        def bump(batch):
+            counts[0] += len(batch)
+
+    return _pipe(Map(bump, parallelism=2, vectorized=True))
+
+
+_G = 0
+
+
+def _global_pipe(bad):
+    if bad:
+        def fn(batch):
+            global _G
+            _G += 1
+    else:
+        def fn(batch):
+            return None
+    return _pipe(Map(fn, parallelism=2, vectorized=True))
+
+
+#: WF### -> (bad_factory, good_factory); factories take tmp_path.
+#: Every bad graph must report exactly its id (subset check: the id is
+#: present); every good twin must validate with ZERO diagnostics.
+CORPUS = {
+    "WF101": (lambda t: _routing_df(None),
+              lambda t: _routing_df(default_routing)),
+    "WF102": (lambda t: _pipe(WinSeq(_red, 4, 8, WinType.CB,
+                                     result_fields=_win_fields())),
+              lambda t: _pipe(WinSeq(_red, 8, 4, WinType.CB,
+                                     result_fields=_win_fields()))),
+    "WF103": (lambda t: _pipe(PaneFarm(_red, _red, 10, 3, WinType.CB,
+                                       plq_result_fields=_win_fields(),
+                                       wlq_result_fields=_win_fields())),
+              lambda t: _pipe(PaneFarm(_red, _red, 10, 5, WinType.CB,
+                                       plq_result_fields=_win_fields(),
+                                       wlq_result_fields=_win_fields()))),
+    "WF201": (lambda t: _native_df(), lambda t: _host_df()),
+    "WF202": (lambda t: _async_df(0.005), lambda t: _async_df(None)),
+    "WF203": (lambda t: _comb_df(async_first=True),
+              lambda t: _comb_df(async_first=False)),
+    "WF204": (lambda t: _recovery_sink_pipe(False),
+              lambda t: _recovery_sink_pipe(True)),
+    "WF205": (lambda t: WireConfig(heartbeat=5.0, stall_timeout=2.0),
+              lambda t: WireConfig.hardened()),
+    "WF206": (lambda t: WireConfig(heartbeat=2.0),
+              lambda t: WireConfig(heartbeat=2.0, stall_timeout=10.0)),
+    "WF207": (lambda t: _pipe(name="obs", metrics=True),
+              lambda t: _pipe(name="obs", metrics=True,
+                              trace_dir=str(t))),
+    "WF208": (lambda t: _pipe(name="ovl", capacity=0,
+                              overload=OverloadPolicy(shed="shed_newest")),
+              lambda t: _pipe(name="ovl", capacity=16,
+                              overload=OverloadPolicy(shed="shed_newest"))),
+    "WF301": (lambda t: _race_pipe(guarded=False),
+              lambda t: _race_pipe(guarded=True)),
+    "WF302": (lambda t: _global_pipe(True),
+              lambda t: _global_pipe(False)),
+}
+
+
+def test_corpus_covers_catalog():
+    from windflow_tpu.check.diagnostics import CATALOG
+    assert set(CORPUS) == set(CATALOG), (
+        "every catalog id needs a minimal failing graph + fixed twin")
+
+
+@pytest.mark.parametrize("code", sorted(CORPUS))
+def test_minimal_failing_graph(code, tmp_path):
+    bad, _good = CORPUS[code]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # WF207's construction warning
+        report = validate(bad(tmp_path))
+    assert code in report.codes(), (
+        f"{code} not reported; got: {report.render()}")
+    from windflow_tpu.check.diagnostics import CATALOG
+    for d in report:
+        if d.code == code:
+            assert d.severity == CATALOG[code][0]
+
+
+@pytest.mark.parametrize("code", sorted(CORPUS))
+def test_minimally_fixed_twin(code, tmp_path):
+    _bad, good = CORPUS[code]
+    report = validate(good(tmp_path))
+    assert len(report) == 0, (
+        f"fixed twin for {code} still reports: {report.render()}")
+
+
+# ---------------------------------------------------------- knob tests
+
+def test_check_error_raises_before_threads():
+    """Acceptance (ISSUE 11): recovery= x native core under
+    check='error' raises BEFORE any thread starts, naming the WF id and
+    the node's canonical node_stats_name."""
+    df = _native_df()
+    df.check = "error"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(CheckError) as ei:
+            df.run()
+    msg = str(ei.value)
+    assert "WF201" in msg
+    from windflow_tpu.utils.tracing import node_stats_name
+    assert node_stats_name("nat", 0, "agg.0") in msg
+    assert df._threads == []          # nothing started
+    assert ei.value.report.has_errors
+
+
+def test_check_warn_reports_and_still_runs():
+    pipe = _pipe(WinSeq(_red, 4, 8, WinType.CB,
+                        result_fields=_win_fields()),
+                 name="warnrun", check="warn")
+    with pytest.warns(CheckWarning, match="WF102"):
+        pipe.run_and_wait_end()
+
+
+def test_check_mode_validated():
+    with pytest.raises(ValueError, match="check="):
+        Dataflow("bad", check="loud")
+
+
+def test_check_events_mirrored(tmp_path):
+    """check diagnostics land in the event log (kind 'check') when the
+    graph is observed."""
+    pipe = _pipe(WinSeq(_red, 4, 8, WinType.CB,
+                        result_fields=_win_fields()),
+                 name="evt", check="warn", metrics=True,
+                 trace_dir=str(tmp_path))
+    with pytest.warns(CheckWarning):
+        pipe.run_and_wait_end()
+    kinds = [e for e in pipe.events.recent if e["event"] == "check"]
+    assert kinds and kinds[0]["code"] == "WF102"
+    assert kinds[0]["severity"] == "warning"
+
+
+def test_union_takes_strictest_check_mode():
+    def mk(name, check):
+        p = MultiPipe(name, check=check)
+        p.add_source(Source(_src, SCHEMA))
+        return p
+    u = union_multipipes(mk("a", "warn"), mk("b", "error"))
+    assert u.check == "error"
+    u2 = union_multipipes(mk("c", "off"), mk("d", None))
+    assert u2.check == "off"
+    u3 = union_multipipes(mk("e", None), mk("f", None))
+    assert u3.check is None
+    with pytest.raises(ValueError, match="check="):
+        MultiPipe("typo", check="eror")   # eager, not deferred to run()
+
+
+def test_union_branch_trace_dir_no_false_wf207(tmp_path):
+    """A union where one branch supplies metrics and the OTHER the
+    trace_dir writes telemetry — no WF207 on the merged graph."""
+    a = MultiPipe("a", metrics=True)
+    a.add_source(Source(_src, SCHEMA))
+    b = MultiPipe("b", trace_dir=str(tmp_path))
+    b.add_source(Source(_src, SCHEMA))
+    u = union_multipipes(a, b)
+    u.add_sink(_sink())
+    report = validate(u)
+    assert "WF207" not in report.codes(), report.render()
+
+
+def test_check_unset_never_imports_package():
+    """Seed contract: check= unset => the check package is never
+    imported (subprocess keeps sys.modules clean)."""
+    code = textwrap.dedent("""
+        import sys
+        import numpy as np
+        from windflow_tpu.api import MultiPipe
+        from windflow_tpu.core.tuples import Schema
+        from windflow_tpu.patterns.basic import Sink, Source
+        S = Schema(value=np.int64)
+        def gen(sh):
+            sh.push(key=0, id=0, ts=0, value=1)
+        got = []
+        p = (MultiPipe("seed")
+             .add_source(Source(gen, S))
+             .chain_sink(Sink(lambda b: got.append(b), vectorized=True)))
+        p.run_and_wait_end()
+        assert any(b is not None and len(b) for b in got)
+        bad = [m for m in sys.modules if m.startswith("windflow_tpu.check")]
+        assert not bad, f"check package imported on seed path: {bad}"
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_wf207_one_shot_engine_warning():
+    """Satellite (ISSUE 11): metrics with no resolvable trace_dir warns
+    at construction, naming the missing knob."""
+    with pytest.warns(UserWarning, match=r"WF207.*trace_dir"):
+        Dataflow("noop", metrics=True)
+
+
+def test_wireconfig_validate_raises():
+    with pytest.raises(ValueError, match="WF205"):
+        WireConfig(heartbeat=5.0, stall_timeout=2.0).validate()
+    WireConfig.hardened().validate()     # clean config chains through
+
+
+def test_open_row_plane_rejects_bad_wire():
+    from windflow_tpu.parallel.multihost import open_row_plane
+    with pytest.raises(ValueError, match="WF205"):
+        open_row_plane(0, {0: ("127.0.0.1", 1), 1: ("127.0.0.1", 2)},
+                       wire=WireConfig(heartbeat=9.0, stall_timeout=1.0))
+
+
+# ------------------------------------------------- suppression directives
+
+def _validate_tmp_module(tmp_path, body, name):
+    mod = tmp_path / f"{name}.py"
+    mod.write_text(body)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(name, str(mod))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return validate(m.build())
+
+
+_SUPPRESSED_SRC = """
+import numpy as np
+from windflow_tpu.api import MultiPipe
+from windflow_tpu.core.tuples import Schema
+from windflow_tpu.core.windows import WinType
+from windflow_tpu.patterns.basic import Map, Sink, Source
+from windflow_tpu.patterns.win_seq import WinSeq
+
+S = Schema(value=np.int64)
+RF = {{"value": np.int64}}
+
+
+def red(k, g, r):
+    return {{"value": r["value"].sum()}}
+
+
+def build():
+    counts = [0]
+
+    def bump(b):
+        counts[0] += len(b){mark301}
+
+    win = WinSeq(red, 4, 8, WinType.CB, result_fields=RF){mark102}
+    return (MultiPipe("sup")
+            .add_source(Source(lambda sh: None, S))
+            .add(Map(bump, parallelism=2, vectorized=True))
+            .add(win)
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+"""
+
+
+def test_suppression_directives(tmp_path):
+    noisy = _validate_tmp_module(
+        tmp_path, _SUPPRESSED_SRC.format(mark301="", mark102=""),
+        "wfmod_noisy")
+    assert {"WF301", "WF102"} <= noisy.codes()
+
+    quiet = _validate_tmp_module(
+        tmp_path, _SUPPRESSED_SRC.format(
+            mark301="   # wf-lint: disable=WF301",
+            mark102="   # wf-lint: disable=WF102"),
+        "wfmod_quiet")
+    assert quiet.codes() == set()
+    assert {d.code for d in quiet.suppressed} >= {"WF102"}
+
+
+def test_directive_parser():
+    from windflow_tpu.check.directives import parse_directive
+    assert parse_directive("x = 1  # wf-lint: disable=WF102") == {"WF102"}
+    assert parse_directive("# wf-lint: disable=wf102, WF301") == \
+        {"WF102", "WF301"}
+    assert parse_directive("# wf-lint: disable") == {"all"}
+    assert parse_directive("# wf-lint:disable=WF102") == {"WF102"}
+    assert parse_directive("plain line") is None
+    # a typo'd id suppresses NOTHING — it must never widen to "all"
+    assert parse_directive("# wf-lint: disable=nonsense") == set()
+    assert parse_directive("# wf-lint: disable=WF30l") == set()
+
+
+# ------------------------------------------------------------- self-lint
+
+APP_MODULES = ("windflow_tpu.apps.micro", "windflow_tpu.apps.pipe",
+               "windflow_tpu.apps.spatial", "windflow_tpu.apps.ysb")
+
+
+@pytest.mark.parametrize("modname", APP_MODULES)
+def test_bench_apps_self_lint(modname):
+    """Tier-1 gate (ISSUE 11): the four bundled bench apps validate
+    diagnostic-free through their wf_check_pipelines() hooks."""
+    import importlib
+    mod = importlib.import_module(modname)
+    targets = mod.wf_check_pipelines()
+    assert targets
+    for target in targets:
+        report = validate(target)
+        assert len(report) == 0, (
+            f"{modname}: {report.render()}")
+
+
+# ------------------------------------------------------------ wf-lint CLI
+
+def _run_lint(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("WF_LOG_DIR", None)
+    env.pop("WF_SAMPLE_PERIOD", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "wf_lint.py"),
+         *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+
+
+def _load_corpus():
+    import importlib.util
+    path = os.path.join(REPO, "tests", "check_corpus.py")
+    spec = importlib.util.spec_from_file_location("check_corpus", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_wf_lint_cli_corpus():
+    """The CLI reports every planted diagnostic of the seeded misconfig
+    corpus and (under --error) exits nonzero."""
+    r = _run_lint(["tests/check_corpus.py", "--error"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    corpus = _load_corpus()
+    for code in corpus.PLANTED:
+        assert code in r.stdout, (
+            f"{code} missing from CLI output:\n{r.stdout}\n{r.stderr}")
+
+
+@pytest.mark.slow
+def test_wf_lint_cli_apps_clean():
+    """All four bench apps lint clean through the CLI (exit 0 even with
+    --error).  Slow-marked: the subprocess cold-imports jax + the apps;
+    the in-process self-lint above is the tier-1 gate."""
+    r = _run_lint(["--error", *APP_MODULES])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 diagnostic(s)" in r.stdout

@@ -1,0 +1,71 @@
+"""Doc/code drift gates (ISSUE 11): the catalogs the code enforces and
+the tables the docs promise must list identical ids — a new WF###
+diagnostic or event kind that skips its documentation row fails tier-1.
+"""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(relpath: str) -> str:
+    with open(os.path.join(REPO, relpath)) as f:
+        return f.read()
+
+
+def test_checks_doc_matches_catalog():
+    """docs/CHECKS.md's table rows == check.diagnostics.CATALOG, id for
+    id — and the doc's severity column matches the catalog severity."""
+    from windflow_tpu.check.diagnostics import CATALOG
+    doc = _read("docs/CHECKS.md")
+    rows = re.findall(r"^\|\s*(WF\d+)\s*\|\s*(\w+)\s*\|", doc, re.M)
+    doc_ids = {code for code, _sev in rows}
+    assert doc_ids == set(CATALOG), (
+        f"docs/CHECKS.md vs CATALOG drift: doc-only "
+        f"{sorted(doc_ids - set(CATALOG))}, catalog-only "
+        f"{sorted(set(CATALOG) - doc_ids)}")
+    for code, sev in rows:
+        assert sev == CATALOG[code][0], (
+            f"{code}: docs/CHECKS.md says {sev!r}, catalog says "
+            f"{CATALOG[code][0]!r}")
+
+
+def _doc_event_kinds() -> set:
+    """Backticked kinds from the first column of the events table in
+    docs/OBSERVABILITY.md (rows may combine kinds with `/`)."""
+    doc = _read("docs/OBSERVABILITY.md")
+    m = re.search(r"^## `events\.jsonl`.*?$(.*?)(?:^## )", doc,
+                  re.M | re.S)
+    assert m, "events.jsonl section missing from docs/OBSERVABILITY.md"
+    kinds = set()
+    for line in m.group(1).splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        first = line.split("|")[1]
+        if "kind" in first and "`" not in first:
+            continue          # header row
+        kinds.update(re.findall(r"`([a-z_]+)`", first))
+    return kinds
+
+
+def test_observability_doc_matches_event_kinds():
+    from windflow_tpu.obs.events import EVENT_KINDS
+    doc_kinds = _doc_event_kinds()
+    assert doc_kinds == set(EVENT_KINDS), (
+        f"docs/OBSERVABILITY.md vs EVENT_KINDS drift: doc-only "
+        f"{sorted(doc_kinds - set(EVENT_KINDS))}, code-only "
+        f"{sorted(set(EVENT_KINDS) - doc_kinds)}")
+
+
+def test_catalog_shape():
+    """Catalog invariants the suppression/docs machinery relies on:
+    id format, known severities, non-empty titles, family prefixes."""
+    from windflow_tpu.check.diagnostics import CATALOG, ERROR, WARNING
+    assert CATALOG, "empty catalog"
+    for code, (sev, title) in CATALOG.items():
+        assert re.fullmatch(r"WF\d{3}", code), code
+        assert sev in (ERROR, WARNING), (code, sev)
+        assert title.strip(), code
+        assert code[2] in "123", f"{code}: unknown family"

@@ -86,7 +86,8 @@ class MultiPipe:
 
     def __init__(self, name: str = "pipe", trace_dir: str = None,
                  capacity: int = 16, overload=None, metrics=None,
-                 sample_period: float = None, recovery=None):
+                 sample_period: float = None, recovery=None,
+                 check: str = None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
@@ -109,6 +110,16 @@ class MultiPipe:
         #: node restart for the materialised graph; None (default) keeps
         #: seed-identical behavior (docs/ROBUSTNESS.md "Recovery")
         self.recovery = recovery
+        #: pre-flight static analysis (docs/CHECKS.md): 'off'/None = seed
+        #: behavior (check/ never imported), 'warn' = report diagnostics
+        #: as CheckWarnings at run(), 'error' = raise CheckError before
+        #: any thread starts.  Validated eagerly — the deferred build
+        #: would otherwise surface a typo'd mode only at run() (or as a
+        #: bare KeyError from the union strictness merge).
+        if check not in Dataflow.CHECK_MODES:
+            raise ValueError(f"check= wants one of {Dataflow.CHECK_MODES}, "
+                             f"got {check!r}")
+        self.check = check
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -288,7 +299,11 @@ class MultiPipe:
                       trace_dir=self.trace_dir, overload=self.overload,
                       metrics=self._metrics_arg,
                       sample_period=self.sample_period,
-                      recovery=self.recovery)
+                      recovery=self.recovery, check=self.check)
+            #: the validator (check/graph.py) anchors window-geometry
+            #: diagnostics at pattern construction sites via the
+            #: declared stage list — only reachable through this stamp
+            df._check_pipe = self
             self._build_into(df)
             self._df = df
         return self._df
@@ -405,11 +420,17 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
     periods = [p.sample_period for p in pipes if p.sample_period is not None]
     registries = [p._metrics_arg for p in pipes if p._metrics_arg]
     trace_dirs = [p.trace_dir for p in pipes if p.trace_dir is not None]
+    # static analysis merges by strictness: any operand asking for
+    # 'error' makes the merged graph raise, any 'warn' at least warns —
+    # loosening one author's check mode would silently drop their gate
+    strictness = {"off": 0, "warn": 1, "error": 2}
+    modes = [p.check for p in pipes if p.check is not None]
+    check = max(modes, key=strictness.__getitem__) if modes else None
     merged = MultiPipe(name, capacity=min(p.capacity for p in pipes),
                        trace_dir=trace_dirs[0] if trace_dirs else None,
                        overload=overload,
                        metrics=registries[0] if registries else None,
                        sample_period=min(periods) if periods else None,
-                       recovery=recovery)
+                       recovery=recovery, check=check)
     merged._branches = list(pipes)
     return merged

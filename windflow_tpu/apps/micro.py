@@ -22,7 +22,11 @@ from ..patterns.basic import Filter, FlatMap, Map, Sink, Source
 SCHEMA = Schema(value=np.int64)
 
 
-def run(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
+def build_micro(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
+    """Assemble the micro pipeline without running it; returns
+    ``(pipe, counters)`` with the shared counter cells the closures
+    update (``sent``/``rcv``/``lat_sum``) so ``run`` — and the static
+    analyzer (scripts/wf_lint.py) — drive the same topology."""
     import threading
     sent = [0]
     sent_lock = threading.Lock()
@@ -67,6 +71,22 @@ def run(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
                         parallelism=pardegree))
             .add(FlatMap(fm, SCHEMA, vectorized=True, parallelism=pardegree))
             .chain_sink(Sink(sink, vectorized=True)))
+    return pipe, {"sent": sent, "rcv": rcv, "lat_sum": lat_sum}
+
+
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): a
+    tiny never-run instance of the benchmark topology.  pardegree 2 so
+    the closure race analyzer sees the replica-shared generator (whose
+    counter updates are lock-guarded — the pattern it must NOT flag)."""
+    pipe, _counters = build_micro(0.0, chunk=1024, pardegree=2)
+    return [pipe]
+
+
+def run(duration_sec=5.0, chunk=4096, pardegree=1, capacity=2):
+    pipe, counters = build_micro(duration_sec, chunk, pardegree, capacity)
+    sent, rcv, lat_sum = (counters["sent"], counters["rcv"],
+                          counters["lat_sum"])
     from ..ops import resident
     resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()

@@ -102,8 +102,12 @@ def expected(chunks) -> tuple[int, int]:
     return total, n_windows
 
 
-def run_once(chunks, pardegree, flush_rows, depth, capacity,
-             max_delay_ms=None, rate=None):
+def build_pipe(chunks, pardegree, flush_rows, depth, capacity,
+               max_delay_ms=None, rate=None):
+    """Assemble the pipe_test_tpu MultiPipe without running it; returns
+    ``(pipe, state)`` where ``state`` is the sink's result-accumulator
+    dict — shared by the timed ``run_once`` and the static analyzer
+    (scripts/wf_lint.py)."""
     state = {"rcv": 0, "total": 0, "lat_us": []}
 
     def gen(shipper):
@@ -151,6 +155,22 @@ def run_once(chunks, pardegree, flush_rows, depth, capacity,
                             flush_rows=flush_rows, depth=depth,
                             max_delay_ms=max_delay_ms))
             .chain_sink(Sink(consume, vectorized=True)))
+    return pipe, state
+
+
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): a
+    tiny never-run instance of the benchmark topology."""
+    pipe, _state = build_pipe([], pardegree=2, flush_rows=1 << 16,
+                              depth=2, capacity=16)
+    return [pipe]
+
+
+def run_once(chunks, pardegree, flush_rows, depth, capacity,
+             max_delay_ms=None, rate=None):
+    pipe, state = build_pipe(chunks, pardegree, flush_rows, depth,
+                             capacity, max_delay_ms=max_delay_ms,
+                             rate=rate)
     resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
     pipe.run_and_wait_end()

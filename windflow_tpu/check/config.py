@@ -1,0 +1,84 @@
+"""Configuration-conflict checks (WF2xx): knobs that are individually
+valid but jointly inert or fatal — the misconfigurations that otherwise
+surface only deep at runtime (a ``recovery=`` graph dying at its first
+checkpoint, a sampler that never writes a file, a heartbeat nobody
+listens to)."""
+
+from __future__ import annotations
+
+from .diagnostics import Diagnostic
+
+
+def check_wire(cfg) -> list[Diagnostic]:
+    """WF205/WF206 over one :class:`~windflow_tpu.parallel.channel.
+    WireConfig` (sender heartbeat vs receiver stall timeout live on the
+    same bundle, so the pairing is statically visible here)."""
+    diags = []
+    hb, stall = cfg.heartbeat, cfg.stall_timeout
+    if hb is not None and stall is not None and hb >= stall:
+        diags.append(Diagnostic(
+            "WF205",
+            f"heartbeat ({hb}s) must be < stall_timeout ({stall}s): the "
+            f"receiver declares PeerStall before a healthy peer's next "
+            f"beat can arrive (size stall_timeout to several heartbeat "
+            f"intervals — WireConfig.hardened() uses 2s/10s)"))
+    elif hb is not None and stall is None:
+        diags.append(Diagnostic(
+            "WF206",
+            f"heartbeat={hb}s is sent but the receiving side has no "
+            f"stall_timeout: beats buy nothing — a dead peer still "
+            f"hangs the read forever (set stall_timeout on the paired "
+            f"RowReceiver/WireConfig, docs/ROBUSTNESS.md)"))
+    return diags
+
+
+def _obs_configured(metrics, sample_period) -> bool:
+    # mirror the engine's truthiness rule: metrics=False/0 means OFF
+    return bool(metrics) or sample_period is not None
+
+
+def check_pipe_config(pipe) -> list[Diagnostic]:
+    """Pre-build knob checks on a MultiPipe — including the conflicts
+    the engine would refuse at ``Dataflow`` construction (WF208), which
+    must be *reportable* here because the deferred build hides them
+    until ``run()``."""
+    diags = []
+    overload = pipe.overload
+    if (overload is not None and getattr(overload, "reshapes_put", False)
+            and pipe.capacity <= 0):
+        diags.append(Diagnostic(
+            "WF208",
+            f"MultiPipe {pipe.name!r}: OverloadPolicy "
+            f"shed={overload.shed!r}/put_deadline="
+            f"{overload.put_deadline} needs a bounded inbox (capacity > "
+            f"0, got {pipe.capacity}): an unbounded queue never sheds "
+            f"and never times out"))
+    from ..utils.tracing import default_trace_dir
+    # judged on the pipe's OWN (merged) knobs only: union_multipipes has
+    # already hoisted the operands' trace_dir/metrics/overload onto the
+    # merged pipe, so recursing into branches would re-judge them in
+    # isolation and report a false WF207 on a union whose other branch
+    # supplies the trace_dir
+    if (_obs_configured(pipe._metrics_arg, pipe.sample_period)
+            and not (pipe.trace_dir or default_trace_dir())):
+        diags.append(_no_trace_dir_diag(pipe.name))
+    return diags
+
+
+def _no_trace_dir_diag(name: str) -> Diagnostic:
+    return Diagnostic(
+        "WF207",
+        f"{name!r} runs with metrics=/sample_period= but no resolvable "
+        f"trace_dir (trace_dir= or WF_LOG_DIR): the live registry works "
+        f"but metrics.jsonl/events.jsonl are never written — set "
+        f"trace_dir to keep the telemetry")
+
+
+def check_dataflow_config(df) -> list[Diagnostic]:
+    """Knob checks on a built Dataflow (the WF208 conflict cannot exist
+    here — the constructor refuses it)."""
+    diags = []
+    if (_obs_configured(df.metrics, df.sample_period)
+            and not df.trace_dir):
+        diags.append(_no_trace_dir_diag(df.name))
+    return diags

@@ -1,0 +1,173 @@
+"""The ``WF###`` diagnostic catalog — the single source of truth for
+code, severity, and one-line meaning.  docs/CHECKS.md documents each
+entry and ``tests/test_docs.py`` drift-tests that the doc table and this
+catalog list identical ids, the same contract ``obs.events.EVENT_KINDS``
+has with the docs/OBSERVABILITY.md event table.
+
+Codes are **append-only**: a released id never changes meaning or
+severity family, because suppression directives (``# wf-lint:
+disable=WF###``) embedded in user code reference them by id.
+
+Numbering: WF1xx graph/topology, WF2xx configuration conflicts, WF3xx
+closure/bytecode analysis.
+"""
+
+from __future__ import annotations
+
+ERROR = "error"
+WARNING = "warning"
+
+#: code -> (severity, one-line title).  docs/CHECKS.md carries the long
+#: form (example, fix, suppression); tests enforce id-set equality.
+CATALOG: dict[str, tuple[str, str]] = {
+    # -- WF1xx: graph / topology ----------------------------------------
+    "WF101": (ERROR,
+              "keyed-state workers fed by a non-keyed (round-robin) "
+              "emitter: same-key rows split across replicas"),
+    "WF102": (WARNING,
+              "hopping window (slide > win_len): rows falling in the "
+              "inter-window gaps are never aggregated"),
+    "WF103": (WARNING,
+              "pane factor does not divide the window: pane "
+              "decomposition degenerates to gcd-sized panes"),
+    # -- WF2xx: configuration conflicts ---------------------------------
+    "WF201": (ERROR,
+              "recovery= over the native C++ resident core: snapshots "
+              "unsupported, first checkpoint dies with "
+              "SnapshotUnsupported"),
+    "WF202": (ERROR,
+              "recovery= over a max_delay_ms device core: wall-clock "
+              "flushes make replay emission boundaries nondeterministic"),
+    "WF203": (ERROR,
+              "recovery= over a fused chain with a non-tail async device "
+              "stage: replay cannot regenerate the emission numbering"),
+    "WF204": (WARNING,
+              "recovery= with a sink not opted into restart: a sink "
+              "crash still tears the graph down (side effects cannot be "
+              "deduplicated)"),
+    "WF205": (ERROR,
+              "WireConfig heartbeat >= stall_timeout: a healthy peer's "
+              "beats arrive too late and every read stall-times-out"),
+    "WF206": (WARNING,
+              "heartbeat sender paired with a receiver lacking "
+              "stall_timeout: the beats are sent but nothing bounds the "
+              "read, so a dead peer still hangs forever"),
+    "WF207": (WARNING,
+              "metrics=/sample_period= with no resolvable trace_dir: "
+              "the sampler runs but metrics.jsonl/events.jsonl are "
+              "never written"),
+    "WF208": (ERROR,
+              "shed/put_deadline overload knobs on unbounded inboxes "
+              "(capacity <= 0): the queue never fills, so the knobs are "
+              "inert while memory grows without bound"),
+    # -- WF3xx: closure race analysis -----------------------------------
+    "WF301": (WARNING,
+              "user function shared by parallel replicas mutates "
+              "closed-over mutable state: probable data race"),
+    "WF302": (WARNING,
+              "user function shared by parallel replicas rebinds a "
+              "module global: probable data race"),
+}
+
+
+class CheckWarning(UserWarning):
+    """Category for ``check='warn'`` diagnostics (and the engine's
+    stand-alone WF207 silent-no-op warning)."""
+
+
+class Diagnostic:
+    """One finding: a catalog code plus the specific site."""
+
+    __slots__ = ("code", "severity", "message", "node", "anchor",
+                 "suppressed")
+
+    def __init__(self, code: str, message: str, node: str = None,
+                 anchor: tuple[str, int] = None):
+        if code not in CATALOG:
+            raise KeyError(f"unknown diagnostic code {code!r} "
+                           f"(add it to check.diagnostics.CATALOG)")
+        self.code = code
+        self.severity = CATALOG[code][0]
+        self.message = message
+        #: canonical node id (tracing.node_stats_name) or node name,
+        #: when the finding pins to one node
+        self.node = node
+        #: (filename, lineno) source anchor, when one is known — pattern
+        #: construction sites and closure bytecode carry these
+        self.anchor = anchor
+        self.suppressed = False
+
+    def where(self) -> str:
+        if self.anchor:
+            return f"{self.anchor[0]}:{self.anchor[1]}"
+        return self.node or "<config>"
+
+    def __str__(self):
+        loc = f" [{self.where()}]" if (self.anchor or self.node) else ""
+        return f"{self.code} {self.severity}: {self.message}{loc}"
+
+    def __repr__(self):
+        return f"<Diagnostic {self.code} {self.where()}>"
+
+
+class CheckReport:
+    """Ordered collection of diagnostics with suppression applied at
+    :meth:`finish` (``# wf-lint: disable=WF###`` at the anchor line —
+    check/directives.py)."""
+
+    def __init__(self):
+        self.diagnostics: list[Diagnostic] = []
+        self.suppressed: list[Diagnostic] = []
+
+    def add(self, diag: Diagnostic):
+        self.diagnostics.append(diag)
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+
+    def finish(self) -> "CheckReport":
+        """Partition out anchor-line-suppressed diagnostics; idempotent."""
+        from .directives import suppressed_at
+        keep, drop = [], []
+        for d in self.diagnostics:
+            if d.anchor and suppressed_at(d.anchor[0], d.anchor[1], d.code):
+                d.suppressed = True
+                drop.append(d)
+            else:
+                keep.append(d)
+        self.diagnostics = keep
+        self.suppressed.extend(drop)
+        return self
+
+    @property
+    def has_errors(self) -> bool:
+        return any(d.severity == ERROR for d in self.diagnostics)
+
+    def codes(self) -> set[str]:
+        return {d.code for d in self.diagnostics}
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def render(self) -> str:
+        if not self.diagnostics:
+            return "no diagnostics"
+        return "\n".join(str(d) for d in self.diagnostics)
+
+
+class CheckError(RuntimeError):
+    """Raised by ``check='error'`` before any node thread starts; carries
+    the full report on ``.report``."""
+
+    def __init__(self, report: CheckReport):
+        self.report = report
+        errs = [d for d in report if d.severity == ERROR]
+        head = (f"{len(errs)} error diagnostic"
+                f"{'s' if len(errs) != 1 else ''} "
+                f"(and {len(report) - len(errs)} warning(s)); "
+                f"docs/CHECKS.md documents each code, `# wf-lint: "
+                f"disable=<code>` at the anchor line suppresses one")
+        super().__init__(head + "\n" + report.render())

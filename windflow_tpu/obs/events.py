@@ -33,6 +33,10 @@ EVENT_KINDS = frozenset({
     # recovery (windflow_tpu/recovery/, docs/ROBUSTNESS.md "Recovery")
     "epoch", "checkpoint", "checkpoint_commit", "checkpoint_skip",
     "restore", "node_restart", "recovery_giveup",
+    # static analysis (windflow_tpu/check/, docs/CHECKS.md): one event
+    # per pre-flight diagnostic when the check= knob runs on an
+    # observed graph
+    "check",
 })
 
 

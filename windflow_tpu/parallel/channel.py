@@ -110,6 +110,23 @@ class WireConfig:
         order), 2 s heartbeats, 10 s stall timeout (= 5 missed beats)."""
         return cls(connect_deadline=60.0, heartbeat=2.0, stall_timeout=10.0)
 
+    def validate(self) -> "WireConfig":
+        """Reject internally inconsistent knob pairings (docs/CHECKS.md
+        WF205): a heartbeat interval at or above the stall timeout makes
+        every healthy-but-idle link stall out — the receiver gives up
+        before the next beat can arrive.  Size ``stall_timeout`` to
+        several heartbeat intervals (``hardened()`` uses 2 s / 10 s).
+        Called by ``open_row_plane`` on every plane; returns self so it
+        chains."""
+        if (self.heartbeat is not None and self.stall_timeout is not None
+                and self.heartbeat >= self.stall_timeout):
+            raise ValueError(
+                f"[WF205] WireConfig: heartbeat ({self.heartbeat}s) must "
+                f"be < stall_timeout ({self.stall_timeout}s) — the "
+                f"receiver would declare PeerStall before a healthy "
+                f"peer's next beat arrives")
+        return self
+
 
 def _encode_dtype(dtype) -> bytes:
     """JSON-encode a dtype via numpy's ``.npy``-format codec

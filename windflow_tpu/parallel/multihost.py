@@ -161,6 +161,7 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                        f"(pid {my_pid}): {sorted(addresses)}")
     if wire is None:
         wire = WireConfig.hardened()
+    wire.validate()   # reject heartbeat >= stall_timeout (WF205)
     host, port = addresses[my_pid]
     receiver = RowReceiver(n_senders=len(addresses) - 1, host=host,
                            port=port, capacity=capacity,

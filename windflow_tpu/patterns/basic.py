@@ -16,6 +16,9 @@ ff_farm(emitter, workers, collector) structure (map.hpp:196-209).
 
 from __future__ import annotations
 
+import os
+import sys
+
 import numpy as np
 
 from ..core.tuples import MARKER_FIELD, Schema
@@ -57,6 +60,31 @@ class Shipper:
             self._rows = []
 
 
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def user_call_site() -> tuple[str, int] | None:
+    """(filename, lineno) of the nearest stack frame OUTSIDE the
+    windflow_tpu package — the line where user/app code constructed the
+    pattern.  Static-analysis diagnostics (windflow_tpu/check/,
+    docs/CHECKS.md) anchor there, and ``# wf-lint: disable=WF###`` on
+    that line suppresses them.  Construction-time only — never on a hot
+    path — and best-effort: None when everything on the stack is
+    internal (e.g. tests driving patterns through framework helpers)."""
+    pkg = _PKG_DIR + os.sep      # separator-guarded: a sibling dir whose
+    apps = os.path.join(_PKG_DIR, "apps") + os.sep   # name merely shares
+    f = sys._getframe(1)                             # the prefix is user code
+    for _ in range(24):
+        if f is None:
+            return None
+        fname = os.path.abspath(f.f_code.co_filename)
+        # the bundled bench apps are *user* code for anchoring purposes
+        if not fname.startswith(pkg) or fname.startswith(apps):
+            return (f.f_code.co_filename, f.f_lineno)
+        f = f.f_back
+    return None
+
+
 class _Pattern:
     """Common shell: parallelism + optional keyed routing."""
 
@@ -64,6 +92,8 @@ class _Pattern:
         self.name = name
         self.parallelism = parallelism
         self.routing = routing  # vectorised fn(keys, n) -> dest
+        #: construction-site anchor for check/ diagnostics
+        self.anchor = user_call_site()
 
     def emitter(self):
         return StandardEmitter(self.parallelism, self.routing,

@@ -55,6 +55,9 @@ class PaneFarm:
         self.name = name
         self.ordered = ordered
         self.config = config or PatternConfig.plain(slide_len)
+        from .basic import user_call_site
+        #: construction-site anchor for check/ diagnostics (WF103)
+        self.anchor = user_call_site()
         cfg = self.config
         pane = self.pane_len
         # --- PLQ stage: tumbling panes, role PLQ (pane_farm.hpp:152-162) ---

@@ -160,6 +160,9 @@ class WinMapReduce:
         self.spec = WindowSpec(win_len, slide_len, win_type)
         self.name = name
         self.config = config or PatternConfig.plain(slide_len)
+        from .basic import user_call_site
+        #: construction-site anchor for check/ diagnostics
+        self.anchor = user_call_site()
         cfg = self.config
         n = map_degree
         self.map_stage = self._make_map_stage(

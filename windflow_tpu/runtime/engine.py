@@ -329,10 +329,17 @@ class Dataflow:
     (MultiPipe::run_and_wait_end spawns cardinality()-1 threads,
     multipipe.hpp:1010; same model here)."""
 
+    #: valid ``check=`` modes (docs/CHECKS.md): None/'off' = seed
+    #: behavior, the check package is never imported; 'warn' = run the
+    #: static validator at run() and report diagnostics as warnings;
+    #: 'error' = additionally raise CheckError (before any thread
+    #: starts) when an error-severity diagnostic survives suppression
+    CHECK_MODES = (None, "off", "warn", "error")
+
     def __init__(self, name: str = "dataflow", capacity: int = 16,
                  trace_dir: str = None, overload: OverloadPolicy = None,
                  metrics=None, sample_period: float = None,
-                 recovery=None):
+                 recovery=None, check: str = None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
@@ -364,11 +371,17 @@ class Dataflow:
             if not isinstance(recovery, RecoveryPolicy):
                 raise TypeError(f"recovery= wants a RecoveryPolicy, got "
                                 f"{type(recovery).__name__}")
+        if check not in self.CHECK_MODES:
+            raise ValueError(f"check= wants one of {self.CHECK_MODES}, "
+                             f"got {check!r}")
         self.name = name
         self.capacity = capacity
         self.trace_dir = trace_dir or default_trace_dir()
         self.overload = overload
         self.recovery = recovery
+        #: pre-flight static-analysis mode (docs/CHECKS.md); run() defers
+        #: to check/ lazily, so the unset default never imports it
+        self.check = check
         self._supervisor = None
         if sample_period is None:
             sample_period = default_sample_period()
@@ -380,6 +393,21 @@ class Dataflow:
         # truthiness, not `is not None`: metrics=False/0 must mean OFF
         # (docs/OBSERVABILITY.md — "any truthy value for a fresh one")
         if metrics or sample_period is not None:
+            if not self.trace_dir:
+                # the silent no-op (ISSUE 11 / WF207): the sampler and
+                # event log run, but with no resolvable directory no
+                # metrics.jsonl/events.jsonl is ever written.  Warn once
+                # per graph, here at construction, naming the missing
+                # knob — the string carries the WF id so the message and
+                # the check/ diagnostic stay greppable as one, without
+                # importing check/ on this path.
+                import warnings
+                warnings.warn(
+                    f"[WF207] Dataflow {name!r}: metrics=/sample_period= "
+                    f"is set but no trace_dir resolves (trace_dir= or "
+                    f"WF_LOG_DIR) — the live registry works, but "
+                    f"metrics.jsonl/events.jsonl will not be written",
+                    stacklevel=2)
             from ..obs import EventLog, MetricsRegistry
             #: live metrics registry shared with channels/user functions
             self.metrics = (metrics if isinstance(metrics, MetricsRegistry)
@@ -837,6 +865,13 @@ class Dataflow:
         if self._threads:
             raise RuntimeError(
                 f"Dataflow {self.name!r} already started; a graph runs once")
+        if self.check not in (None, "off"):
+            # pre-flight static analysis (docs/CHECKS.md): warn or — in
+            # 'error' mode — raise CheckError BEFORE any thread (node,
+            # sampler, supervisor writer) starts.  Lazily imported: the
+            # unset default never touches the check package.
+            from ..check import enforce
+            enforce(self)
         if self.recovery is not None and self._supervisor is None:
             from ..recovery.supervisor import Supervisor
             self._supervisor = Supervisor(self, self.recovery)
