@@ -65,6 +65,31 @@ def _rates(cur, prev):
     return out
 
 
+def _control_line(cur):
+    """Controller state from the ctl_* metrics (docs/CONTROL.md): active
+    farm widths, admission rate cap, adaptive soft limit, and the
+    decision counters — empty string when no control plane runs."""
+    gauges = cur.get("gauges", {})
+    counters = cur.get("counters", {})
+    parts = []
+    for k in sorted(gauges):
+        if k.startswith("ctl_width_"):
+            parts.append(f"width[{k[len('ctl_width_'):]}]="
+                         f"{int(gauges[k])}")
+    for k in sorted(gauges):
+        if k.startswith("ctl_admission_rate"):
+            tgt = k[len("ctl_admission_rate"):].lstrip("_") or "*"
+            parts.append(f"admit[{tgt}]={gauges[k]:.0f}/s")
+    if gauges.get("ctl_soft_limit"):
+        parts.append(f"soft_limit={int(gauges['ctl_soft_limit'])}")
+    ctl_counts = {k[4:]: v for k, v in counters.items()
+                  if k.startswith("ctl_") and v}
+    if ctl_counts:
+        parts.append("  ".join(f"{k}={v}"
+                               for k, v in sorted(ctl_counts.items())))
+    return "control: " + "  ".join(parts) if parts else ""
+
+
 def render(cur, prev, events=(), clock=time.localtime):
     """One frame of the view as a string (pure: testable without a tty)."""
     rates = _rates(cur, prev)
@@ -85,7 +110,12 @@ def render(cur, prev, events=(), clock=time.localtime):
                str(n["shed"]), str(n["quarantined"]))
         lines.append("  ".join(c.ljust(w) if i == 0 else c.rjust(w)
                                for i, (c, w) in enumerate(zip(row, _W))))
-    counters = {k: v for k, v in cur.get("counters", {}).items() if v}
+    ctl = _control_line(cur)
+    if ctl:
+        lines.append("")
+        lines.append(ctl)
+    counters = {k: v for k, v in cur.get("counters", {}).items()
+                if v and not k.startswith("ctl_")}
     if counters:
         lines.append("")
         lines.append("counters: " + "  ".join(

@@ -25,6 +25,9 @@ import pytest
 
 from windflow_tpu.api import MultiPipe, union_multipipes
 from windflow_tpu.check import CheckError, CheckWarning, validate
+from windflow_tpu.control import Admission, ControlPolicy, Rescale
+from windflow_tpu.ops.functions import Reducer
+from windflow_tpu.patterns.key_farm import KeyFarm
 from windflow_tpu.core.tuples import Schema
 from windflow_tpu.core.windows import WindowSpec, WinType
 from windflow_tpu.parallel.channel import WireConfig
@@ -168,6 +171,33 @@ def _race_pipe(guarded):
     return _pipe(Map(bump, parallelism=2, vectorized=True))
 
 
+def _ctl_pipe(t, *, rescale=True, recovery=True, obs=True,
+              recoverable=None, target="kf"):
+    """Control-plane corpus factory (WF209-212): a keyed farm under a
+    ControlPolicy, with the blinding / recoverable / recovery / target
+    knobs toggled per case.  The sink opts into restart so recovery=
+    twins stay WF204-clean."""
+    if rescale:
+        rules = [Rescale(target, max_workers=4)]
+    else:
+        rules = [Admission(max_rate=1e6, min_rate=1e3, high_depth=8,
+                           low_depth=2)]
+    kf = KeyFarm(Reducer("sum", "value"), win_len=8, slide_len=4,
+                 pardegree=2, name="kf")
+    if recoverable is not None:
+        kf.recoverable = recoverable
+    s = _sink()
+    s.recoverable = True
+    p = MultiPipe("ctl", control=ControlPolicy(rules),
+                  recovery=RecoveryPolicy() if recovery else None,
+                  metrics=True if obs else None,
+                  trace_dir=str(t) if obs else None)
+    p.add_source(Source(_src, SCHEMA))
+    p.add(kf)
+    p.add_sink(s)
+    return p
+
+
 _G = 0
 
 
@@ -215,6 +245,15 @@ CORPUS = {
                               overload=OverloadPolicy(shed="shed_newest")),
               lambda t: _pipe(name="ovl", capacity=16,
                               overload=OverloadPolicy(shed="shed_newest"))),
+    "WF209": (lambda t: _ctl_pipe(t, rescale=False, recovery=False,
+                                  obs=False),
+              lambda t: _ctl_pipe(t, rescale=False, recovery=False)),
+    "WF210": (lambda t: _ctl_pipe(t, recoverable=False),
+              lambda t: _ctl_pipe(t)),
+    "WF211": (lambda t: _ctl_pipe(t, recovery=False),
+              lambda t: _ctl_pipe(t)),
+    "WF212": (lambda t: _ctl_pipe(t, target="kfarm"),
+              lambda t: _ctl_pipe(t)),
     "WF301": (lambda t: _race_pipe(guarded=False),
               lambda t: _race_pipe(guarded=True)),
     "WF302": (lambda t: _global_pipe(True),

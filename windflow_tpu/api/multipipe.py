@@ -87,7 +87,7 @@ class MultiPipe:
     def __init__(self, name: str = "pipe", trace_dir: str = None,
                  capacity: int = 16, overload=None, metrics=None,
                  sample_period: float = None, recovery=None,
-                 check: str = None):
+                 check: str = None, control=None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
@@ -120,6 +120,11 @@ class MultiPipe:
             raise ValueError(f"check= wants one of {Dataflow.CHECK_MODES}, "
                              f"got {check!r}")
         self.check = check
+        #: control/policy.ControlPolicy — the closed-loop control plane
+        #: (docs/CONTROL.md): elastic rescale at epoch barriers, adaptive
+        #: shedding, source admission.  None (default) keeps seed-
+        #: identical behavior and never imports windflow_tpu.control.
+        self.control = control
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -299,7 +304,8 @@ class MultiPipe:
                       trace_dir=self.trace_dir, overload=self.overload,
                       metrics=self._metrics_arg,
                       sample_period=self.sample_period,
-                      recovery=self.recovery, check=self.check)
+                      recovery=self.recovery, check=self.check,
+                      control=self.control)
             #: the validator (check/graph.py) anchors window-geometry
             #: diagnostics at pattern construction sites via the
             #: declared stage list — only reachable through this stamp
@@ -357,14 +363,34 @@ class MultiPipe:
         when observability is off); `.recent` holds the in-memory tail."""
         return self._df.events if self._df is not None else None
 
+    @property
+    def controller(self):
+        """The materialised graph's control-plane Controller (None
+        before run() or when ``control=`` is unset/blind) — the handle
+        for scripted ``request_rescale`` calls (docs/CONTROL.md)."""
+        return self._df._controller if self._df is not None else None
+
     def getNumThreads(self) -> int:
         """Thread count of the materialised graph (multipipe.hpp:973).
         Before run() this builds a throwaway preview graph, so the pipe
         stays open for further add()/chain() calls."""
         if self._df is not None:
             return self._df.cardinality()
-        df = Dataflow(self.name, capacity=self.capacity,
-                      trace_dir=self.trace_dir)
+        import warnings
+        with warnings.catch_warnings():
+            # a control= preview would re-fire the construction-time
+            # WF209/WF207 warnings the real build already owns
+            warnings.simplefilter("ignore")
+            # control changes the materialised cardinality (farms
+            # pre-provision to a Rescale rule's max_workers, but only
+            # when the graph is observed — blind control provisions
+            # nothing), so the preview graph must carry the control,
+            # recovery AND observability knobs to match the real build
+            df = Dataflow(self.name, capacity=self.capacity,
+                          trace_dir=self.trace_dir,
+                          metrics=self._metrics_arg,
+                          sample_period=self.sample_period,
+                          recovery=self.recovery, control=self.control)
         self._build_into(df)
         return df.cardinality()
 
@@ -396,13 +422,24 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
     policies = [p.overload for p in pipes if p.overload is not None]
     overload = policies[0] if policies else None
     for pol in policies[1:]:
-        if (pol.shed, pol.put_deadline, pol.error_budget) != (
+        if (pol.shed, pol.put_deadline, pol.error_budget,
+                pol.soft_limit) != (
                 overload.shed, overload.put_deadline,
-                overload.error_budget):
+                overload.error_budget, overload.soft_limit):
             raise ValueError(
                 f"cannot union MultiPipes with conflicting overload "
                 f"policies ({overload!r} vs {pol!r}): one Dataflow runs "
                 f"one policy — configure it on the merged pipe")
+    # one Dataflow runs one controller: configured control policies must
+    # agree (or all but one be unset), like overload/recovery policies
+    ctl_pols = [p.control for p in pipes if p.control is not None]
+    control = ctl_pols[0] if ctl_pols else None
+    for pol in ctl_pols[1:]:
+        if not control.agrees_with(pol):
+            raise ValueError(
+                f"cannot union MultiPipes with conflicting control "
+                f"policies ({control!r} vs {pol!r}): one Dataflow runs "
+                f"one controller — configure it on the merged pipe")
     # one Dataflow runs one recovery policy: configured policies must
     # agree (or all but one be unset), like overload policies
     rec_pols = [p.recovery for p in pipes if p.recovery is not None]
@@ -431,6 +468,6 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                        overload=overload,
                        metrics=registries[0] if registries else None,
                        sample_period=min(periods) if periods else None,
-                       recovery=recovery, check=check)
+                       recovery=recovery, check=check, control=control)
     merged._branches = list(pipes)
     return merged

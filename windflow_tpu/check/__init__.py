@@ -50,13 +50,19 @@ def validate(target) -> CheckReport:
         return report.finish()
     if hasattr(target, "_build") and hasattr(target, "_stages"):
         # a MultiPipe: pre-build knob checks first — a fatal knob
-        # conflict (WF208) means _build() itself would raise, so the
-        # static report must not attempt it
+        # conflict (WF208 at the Dataflow constructor, WF210/WF211 at
+        # the control-plane wiring) means _build() itself would raise,
+        # so the static report must not attempt it
         pre = check_pipe_config(target)
         report.extend(pre)
-        if any(d.code == "WF208" for d in pre):
+        if any(d.code in ("WF208", "WF210", "WF211") for d in pre):
             return report.finish()
-        df = target._build()
+        with warnings.catch_warnings():
+            # the Dataflow constructor re-warns the WF207/WF209
+            # conditions this report already carries as diagnostics —
+            # a lint run must not double-fire them as live warnings
+            warnings.simplefilter("ignore")
+            df = target._build()
         report.extend(check_dataflow(df, skip_config=True))
         return report.finish()
     # a built Dataflow

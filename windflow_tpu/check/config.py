@@ -37,11 +37,105 @@ def _obs_configured(metrics, sample_period) -> bool:
     return bool(metrics) or sample_period is not None
 
 
+def _iter_pipe_patterns(pipe):
+    for branch in pipe._branches:
+        yield from _iter_pipe_patterns(branch)
+    for _kind, pattern in pipe._stages:
+        yield pattern
+
+
+def check_pipe_control(pipe) -> list[Diagnostic]:
+    """WF209/210/211 over a MultiPipe's ``control=`` knob — the WF210/
+    WF211 conflicts are refused outright at build/construction time
+    (like WF208), so they must be *reportable* pre-build."""
+    diags = []
+    ctl = pipe.control
+    if ctl is None:
+        return diags
+    if not _obs_configured(pipe._metrics_arg, pipe.sample_period):
+        diags.append(_blind_control_diag(f"MultiPipe {pipe.name!r}"))
+    if getattr(ctl, "has_rescale", False) and pipe.recovery is None:
+        diags.append(Diagnostic(
+            "WF211",
+            f"MultiPipe {pipe.name!r}: control= has Rescale rules but "
+            f"recovery= is unset — live rescale seals at epoch "
+            f"barriers, which only a RecoveryPolicy's epoch triggers "
+            f"inject (the Dataflow constructor refuses this pair; "
+            f"docs/CONTROL.md)"))
+    targeted = {r.pattern for r in getattr(ctl, "rules", ())
+                if type(r).__name__ == "Rescale"}
+    wired = {getattr(p, "name", None)
+             for p in _iter_pipe_patterns(pipe)}
+    for missing in sorted(targeted - wired):
+        diags.append(Diagnostic(
+            "WF212",
+            f"Rescale rule targets {missing!r}, but no pattern of that "
+            f"name is wired into MultiPipe {pipe.name!r} — the "
+            f"controller will refuse to attach at run() (typo'd "
+            f"pattern name?)", node=missing))
+    for pattern in _iter_pipe_patterns(pipe):
+        name = getattr(pattern, "name", None)
+        rule = ctl.rescale_for(name)
+        if rule is None:
+            continue
+        anchor = getattr(pattern, "anchor", None)
+        width = getattr(pattern, "_ctl_width0", None)
+        if width is None:
+            width = getattr(pattern, "parallelism", 1)
+        if getattr(pattern, "routing", None) is None:
+            diags.append(Diagnostic(
+                "WF210",
+                f"Rescale rule targets {name!r}, which is not "
+                f"key-partitioned (no keyed routing): live rescale "
+                f"migrates per-key state between workers — wrap the "
+                f"computation in a Key_Farm (docs/CONTROL.md)",
+                node=name, anchor=anchor))
+        elif getattr(pattern, "recoverable", None) is False:
+            diags.append(Diagnostic(
+                "WF210",
+                f"Rescale rule targets {name!r}, whose recoverable "
+                f"flag is opted out: a pattern that cannot snapshot "
+                f"cannot seal the migration cut — drop the opt-out or "
+                f"the rule (docs/CONTROL.md)",
+                node=name, anchor=anchor))
+        elif not rule.min_workers <= width <= rule.max_workers:
+            # the wiring layer refuses this at build, so it must be
+            # REPORTABLE pre-build like WF208 (the skip list below keeps
+            # validate() from attempting the raising _build)
+            diags.append(Diagnostic(
+                "WF210",
+                f"Rescale rule for {name!r}: declared parallelism "
+                f"{width} is outside the rule's "
+                f"[{rule.min_workers}, {rule.max_workers}] range — the "
+                f"build refuses it (docs/CONTROL.md)",
+                node=name, anchor=anchor))
+        elif getattr(pattern, "n_emitters", 1) > 1:
+            diags.append(Diagnostic(
+                "WF210",
+                f"Rescale rule targets multi-emitter farm {name!r}: "
+                f"ordered multi-emitter merges pin the channel count "
+                f"at build time and cannot rescale (docs/CONTROL.md)",
+                node=name, anchor=anchor))
+        elif type(pattern).__name__.endswith("TPU"):
+            # duck-typed like the WF201 native-core probe: device farm
+            # workers mirror per-key rows into HBM rings / native
+            # tables the host migration hooks cannot move, so their
+            # cores set keyed_migratable=False and attach refuses
+            diags.append(Diagnostic(
+                "WF210",
+                f"Rescale rule targets device farm {name!r} "
+                f"({type(pattern).__name__}): device cores decline "
+                f"keyed-state migration (per-key rows live in device "
+                f"rings) — target a host Key_Farm (docs/CONTROL.md)",
+                node=name, anchor=anchor))
+    return diags
+
+
 def check_pipe_config(pipe) -> list[Diagnostic]:
     """Pre-build knob checks on a MultiPipe — including the conflicts
-    the engine would refuse at ``Dataflow`` construction (WF208), which
-    must be *reportable* here because the deferred build hides them
-    until ``run()``."""
+    the engine would refuse at ``Dataflow`` construction (WF208/WF210/
+    WF211), which must be *reportable* here because the deferred build
+    hides them until ``run()``."""
     diags = []
     overload = pipe.overload
     if (overload is not None and getattr(overload, "reshapes_put", False)
@@ -53,6 +147,7 @@ def check_pipe_config(pipe) -> list[Diagnostic]:
             f"{overload.put_deadline} needs a bounded inbox (capacity > "
             f"0, got {pipe.capacity}): an unbounded queue never sheds "
             f"and never times out"))
+    diags.extend(check_pipe_control(pipe))
     from ..utils.tracing import default_trace_dir
     # judged on the pipe's OWN (merged) knobs only: union_multipipes has
     # already hoisted the operands' trace_dir/metrics/overload onto the
@@ -65,6 +160,15 @@ def check_pipe_config(pipe) -> list[Diagnostic]:
     return diags
 
 
+def _blind_control_diag(owner: str) -> Diagnostic:
+    return Diagnostic(
+        "WF209",
+        f"{owner}: control= is set but neither metrics= nor "
+        f"sample_period= is — the controller never receives a sampler "
+        f"snapshot, so no rule can fire (set metrics=True; "
+        f"docs/CONTROL.md)")
+
+
 def _no_trace_dir_diag(name: str) -> Diagnostic:
     return Diagnostic(
         "WF207",
@@ -75,10 +179,12 @@ def _no_trace_dir_diag(name: str) -> Diagnostic:
 
 
 def check_dataflow_config(df) -> list[Diagnostic]:
-    """Knob checks on a built Dataflow (the WF208 conflict cannot exist
-    here — the constructor refuses it)."""
+    """Knob checks on a built Dataflow (the WF208/WF210/WF211 conflicts
+    cannot exist here — constructor and wiring refuse them)."""
     diags = []
     if (_obs_configured(df.metrics, df.sample_period)
             and not df.trace_dir):
         diags.append(_no_trace_dir_diag(df.name))
+    if df.control is not None and df.metrics is None:
+        diags.append(_blind_control_diag(f"Dataflow {df.name!r}"))
     return diags

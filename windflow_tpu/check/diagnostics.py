@@ -60,6 +60,21 @@ CATALOG: dict[str, tuple[str, str]] = {
               "shed/put_deadline overload knobs on unbounded inboxes "
               "(capacity <= 0): the queue never fills, so the knobs are "
               "inert while memory grows without bound"),
+    "WF209": (WARNING,
+              "control= set without metrics=/sample_period=: the "
+              "controller's only sensor is the sampler, so every rule "
+              "is silently inert"),
+    "WF210": (ERROR,
+              "Rescale rule targets a pattern that cannot migrate "
+              "keyed state (recoverable opted out, or not "
+              "key-partitioned): the migration cut can never seal"),
+    "WF211": (ERROR,
+              "control= has Rescale rules but recovery= is unset: live "
+              "rescale seals at epoch barriers, which only a "
+              "RecoveryPolicy's triggers inject"),
+    "WF212": (ERROR,
+              "Rescale rule targets a pattern name not wired into the "
+              "graph: the controller refuses to attach at run()"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "

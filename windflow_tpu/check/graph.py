@@ -17,6 +17,7 @@ as checkable as the real native one.
 from __future__ import annotations
 
 from .closures import analyze_function
+from .config import _iter_pipe_patterns as _iter_patterns
 from .config import check_dataflow_config
 from .diagnostics import Diagnostic
 
@@ -186,13 +187,6 @@ def _check_windows(df) -> list[Diagnostic]:
                 diags.append(_hopping_diag(spec, _stats_name(df, node),
                                            None))
     return diags
-
-
-def _iter_patterns(pipe):
-    for branch in pipe._branches:
-        yield from _iter_patterns(branch)
-    for _kind, pattern in pipe._stages:
-        yield pattern
 
 
 def _hopping_diag(spec, where, anchor):

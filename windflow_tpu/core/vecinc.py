@@ -386,6 +386,74 @@ class VecIncTumblingCore:
             out[name] = vals[name]
         return out
 
+    # -------------------------------------------------- keyed state migration
+    # The control plane's live rescale (docs/CONTROL.md) moves per-key
+    # state between sibling farm workers at an epoch barrier.  Slots are
+    # never removed from the SlotMap: export NEUTRALIZES the source
+    # slot (last_pos back to -inf marks it dead — a registered key
+    # always has last_pos set by its first chunk), and import overwrites
+    # whatever the destination slot holds.  Derived per-key fields
+    # (initial, fgwid, inner_off) are recomputed by slot registration —
+    # sibling workers share one PatternConfig, so they are identical.
+
+    _FRAG_KIND = "vec_tumbling"
+    #: all per-key state is in the host slot arrays — migratable
+    keyed_migratable = True
+
+    def keyed_state_keys(self) -> np.ndarray:
+        live = self._last_pos[:self._n] > _NEG_INF
+        return self._key[:self._n][live].copy()
+
+    def _export_acc(self, slots) -> dict:
+        out = {"acc_ts": self._acc_ts[slots].copy(),
+               "acc": {of: self._acc[of][slots].copy()
+                       for (of, _f, _u, _dt, _i) in self._parts}}
+        self._acc_ts[slots] = 0
+        for (of, _f, _u, _dt, ident) in self._parts:
+            self._acc[of][slots] = ident
+        return out
+
+    def _import_acc(self, slots, frag):
+        self._acc_ts[slots] = frag["acc_ts"]
+        for of, v in frag["acc"].items():
+            self._acc[of][slots] = v
+
+    def keyed_state_export(self, keys: np.ndarray) -> dict:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        slots = self._slots_for(keys)
+        frag = {
+            "kind": self._FRAG_KIND,
+            "keys": keys,
+            "last_pos": self._last_pos[slots].copy(),
+            "nfired": self._nfired[slots].copy(),
+            "seen": self._seen[slots].copy(),
+            "emit_ctr": self._emit_ctr[slots].copy(),
+            "marker_pos": self._marker_pos[slots].copy(),
+            "marker_ts": self._marker_ts[slots].copy(),
+        }
+        frag.update(self._export_acc(slots))
+        self._last_pos[slots] = _NEG_INF
+        self._nfired[slots] = 0
+        self._seen[slots] = False
+        self._emit_ctr[slots] = (self.map_indexes[0]
+                                 if self.role is Role.MAP else 0)
+        self._marker_pos[slots] = _NEG_INF
+        self._marker_ts[slots] = 0
+        return frag
+
+    def keyed_state_import(self, frag: dict):
+        if frag["kind"] != self._FRAG_KIND:
+            raise TypeError(f"cannot import {frag['kind']!r} state into "
+                            f"{type(self).__name__}")
+        slots = self._slots_for(frag["keys"])
+        self._last_pos[slots] = frag["last_pos"]
+        self._nfired[slots] = frag["nfired"]
+        self._seen[slots] = frag["seen"]
+        self._emit_ctr[slots] = frag["emit_ctr"]
+        self._marker_pos[slots] = frag["marker_pos"]
+        self._marker_ts[slots] = frag["marker_ts"]
+        self._import_acc(slots, frag)
+
     # -------------------------------------------------------------------- EOS
 
     def flush(self) -> np.ndarray:
@@ -561,6 +629,21 @@ class VecIncSlidingCore(VecIncTumblingCore):
         if total == 0:
             return np.zeros(0, dtype=self._result_dtype)
         return self._make_results(out_slot, out_lwid, out_ts, out_vals)
+
+    # keyed migration: the tumbling fragment plus the created-window
+    # count; the 1D acc copies generalise to (m, W) lane rows untouched
+    _FRAG_KIND = "vec_sliding"
+
+    def keyed_state_export(self, keys: np.ndarray) -> dict:
+        frag = super().keyed_state_export(keys)
+        slots = self._slots_for(frag["keys"])
+        frag["ncreated"] = self._ncreated[slots].copy()
+        self._ncreated[slots] = 0
+        return frag
+
+    def keyed_state_import(self, frag: dict):
+        super().keyed_state_import(frag)
+        self._ncreated[self._slots_for(frag["keys"])] = frag["ncreated"]
 
     def flush(self) -> np.ndarray:
         """EOS: every created-but-unfired window fires, oldest first
@@ -778,3 +861,43 @@ class LazySlidingCore:
 
     def use_incremental(self):
         return self  # both backing cores compute the monoid INC == NIC
+
+    # -------------------------------------------------- keyed state migration
+    # Sibling workers may have picked DIFFERENT backings (each decides on
+    # its own first chunk): before migrating, control/rescale.py
+    # harmonizes every involved LazySlidingCore onto one backing class
+    # via ensure_backing — escalation is lossless (the per-key core's
+    # archives rebuild the lane accumulators, see _escalate), the
+    # reverse direction is not, so vec wins whenever any sibling runs it.
+
+    #: both possible backings are host cores
+    keyed_migratable = True
+
+    def ensure_backing(self, vec: bool):
+        if self._core is None:
+            if vec:
+                self._core = VecIncSlidingCore(self.spec, self.winfunc,
+                                               **self._kw)
+            else:
+                from .winseq import WinSeqCore
+                self._core = WinSeqCore(self.spec, self.winfunc,
+                                        **self._kw)
+                self._perkey = True
+        elif vec and self._perkey:
+            self._escalate()
+
+    @property
+    def backing_is_vec(self):
+        """None before the first chunk, else whether the lane core runs."""
+        return None if self._core is None else not self._perkey
+
+    def keyed_state_keys(self):
+        if self._core is None:
+            return np.zeros(0, dtype=np.int64)
+        return self._core.keyed_state_keys()
+
+    def keyed_state_export(self, keys):
+        return self._core.keyed_state_export(keys)
+
+    def keyed_state_import(self, frag):
+        return self._core.keyed_state_import(frag)

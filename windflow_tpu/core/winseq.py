@@ -327,6 +327,42 @@ class WinSeqCore:
                 cols[n][i] = v
         return cols
 
+    # -------------------------------------------------- keyed state migration
+
+    #: explicit opt-in for the control plane's live rescale
+    #: (control/rescale.py): the hooks below move the HOST per-key
+    #: state only, so subclasses that mirror state elsewhere (device
+    #: HBM ring archives, native C tables) MUST override this to False
+    #: or a rescale would migrate half a key's state
+    keyed_migratable = True
+
+    def keyed_state_keys(self) -> np.ndarray:
+        """Keys holding live state — the unit the control plane's live
+        rescale repartitions (docs/CONTROL.md).  Key-partitioned farm
+        workers share one PatternConfig, so a key's ``_KeyState`` is
+        meaningful verbatim on any sibling worker."""
+        if not self._keys:
+            return np.zeros(0, dtype=np.int64)
+        return np.fromiter(self._keys.keys(), dtype=np.int64,
+                           count=len(self._keys))
+
+    def keyed_state_export(self, keys: np.ndarray) -> dict:
+        """Remove and return the per-key state of ``keys`` (a fragment
+        ``keyed_state_import`` absorbs on a same-class, same-config
+        sibling core).  Only called while both cores are quiescent (the
+        rescale barrier parks every worker thread)."""
+        return {"kind": "winseq",
+                "keys": {int(k): self._keys.pop(int(k)) for k in keys},
+                "in_dtype": self._in_dtype}
+
+    def keyed_state_import(self, frag: dict):
+        if frag["kind"] != "winseq":  # harmonized by control/rescale.py
+            raise TypeError(f"cannot import {frag['kind']!r} state into "
+                            f"WinSeqCore")
+        if self._in_dtype is None:
+            self._in_dtype = frag["in_dtype"]
+        self._keys.update(frag["keys"])
+
     # ------------------------------------------------------------------- EOS
 
     def flush(self) -> np.ndarray:

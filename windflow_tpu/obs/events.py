@@ -37,6 +37,11 @@ EVENT_KINDS = frozenset({
     # per pre-flight diagnostic when the check= knob runs on an
     # observed graph
     "check",
+    # control plane (windflow_tpu/control/, docs/CONTROL.md): one
+    # `control` event per controller decision (rescale request, shed
+    # tighten/relax, admission rate move), one `rescale` event per
+    # completed epoch-barrier migration
+    "control", "rescale",
 })
 
 

@@ -40,12 +40,32 @@ class Sampler:
                              f"got {period}")
         self._stop = threading.Event()
         self._last_shed: dict[str, int] = {}
+        self._subs: list = []
+        #: last exception a subscriber raised (diagnostics; the sampler
+        #: itself never dies on a bad subscriber)
+        self.sub_error = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"{dataflow.name}/sampler")
         #: samples taken (monotone; the "seq" field of the next line)
         self.seq = 0
 
     # ------------------------------------------------------------ lifecycle
+
+    def subscribe(self, fn):
+        """Register an in-process snapshot consumer: ``fn(rec)`` is
+        called on the sampler thread with every sample dict (the
+        pre-serialisation ``metrics.jsonl`` record) — the control
+        plane's sensor bus (docs/CONTROL.md), and the way any in-process
+        supervisor reads live telemetry without tailing files.
+
+        Contract: treat ``rec`` as read-only (the same dict is
+        serialised to disk afterwards), return fast (the callback runs
+        between samples), and raise nothing you care about — a
+        subscriber exception is recorded on ``sub_error`` and swallowed
+        so one bad consumer cannot kill everyone's telemetry.
+        ``sample()`` itself stays a pure read; only the thread-owned
+        ``_write_sample`` fans out to subscribers."""
+        self._subs.append(fn)
 
     def start(self):
         self._thread.start()
@@ -133,6 +153,25 @@ class Sampler:
         rec = self.sample()
         self.seq += 1
         self._emit_shed_events(rec["nodes"])
+        for fn in self._subs:
+            try:
+                fn(rec)
+            except Exception as e:  # noqa: BLE001 — see subscribe()
+                first = self.sub_error is None
+                self.sub_error = e
+                # a silently-dead subscriber (e.g. the control plane's
+                # controller) must still be observable: count every
+                # failure, warn once on the first
+                m = self.df.metrics
+                if m is not None:
+                    m.counter("sampler_subscriber_errors").inc()
+                if first:
+                    import warnings
+                    warnings.warn(
+                        f"sampler subscriber {getattr(fn, '__qualname__', fn)!r} "
+                        f"raised {type(e).__name__}: {e} (further "
+                        f"failures only count sampler_subscriber_errors)",
+                        stacklevel=2)
         if f is not None:
             json.dump(rec, f)
             f.write("\n")

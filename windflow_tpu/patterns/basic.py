@@ -395,6 +395,27 @@ class _AccumulatorNode(Node):
             self._keys[key] = acc
         return acc
 
+    # keyed-state migration (control plane live rescale, docs/CONTROL.md):
+    # the fold state is a plain key -> record dict, so fragments move
+    # verbatim between sibling replicas of one keyed farm
+    keyed_migratable = True
+
+    def keyed_state_keys(self):
+        if not self._keys:
+            return np.zeros(0, dtype=np.int64)
+        return np.fromiter(self._keys.keys(), dtype=np.int64,
+                           count=len(self._keys))
+
+    def keyed_state_export(self, keys):
+        return {"kind": "accumulator",
+                "keys": {int(k): self._keys.pop(int(k)) for k in keys}}
+
+    def keyed_state_import(self, frag):
+        if frag["kind"] != "accumulator":
+            raise TypeError(f"cannot import {frag['kind']!r} state into "
+                            f"{type(self).__name__}")
+        self._keys.update(frag["keys"])
+
     def svc(self, batch, channel=0):
         if len(batch) == 0:
             return

@@ -134,6 +134,11 @@ class _AsyncLaunchRecovery:
 class DeviceWinSeqCore(_AsyncLaunchRecovery, WinSeqCore):
     """WinSeqCore whose fired-window evaluation is device-batched."""
 
+    #: control-plane live rescale declined (docs/CONTROL.md): the
+    #: inherited keyed hooks would migrate only the host bookkeeping
+    #: while launch queues / staged device work stay behind
+    keyed_migratable = False
+
     def __init__(self, spec: WindowSpec, winfunc, batch_len: int = 512,
                  config: PatternConfig = None, role: Role = Role.SEQ,
                  map_indexes=(0, 1), result_ts_slide=None, device=None,
@@ -367,6 +372,12 @@ class ResidentWinSeqCore(_AsyncLaunchRecovery, WinSeqCore):
     * the host archive's purge is deferred to flush time so a rebase (ring
       compaction) can always rebuild the ring from host-live rows.
     """
+
+    #: control-plane live rescale declined (docs/CONTROL.md): a key's
+    #: rows are mirrored into THIS worker's HBM ring archive — the
+    #: inherited host-dict hooks cannot move that half (extending the
+    #: migration to device rings rides ROADMAP Open item 5's ABI work)
+    keyed_migratable = False
 
     def __init__(self, spec: WindowSpec, reducer, batch_len: int = 8192,
                  flush_rows: int = 1 << 20, config: PatternConfig = None,

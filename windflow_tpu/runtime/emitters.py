@@ -254,34 +254,43 @@ def default_routing(keys: np.ndarray, n: int) -> np.ndarray:
 
 class StandardEmitter(Node):
     """Pass-through (n=1), block round-robin, or keyed routing emitter
-    (standard.hpp:40-88)."""
+    (standard.hpp:40-88).
+
+    ``n_active`` <= ``n_dest`` is the width actually routed over: equal
+    by default (seed behavior), narrower when the control plane
+    pre-provisioned the farm to a ``Rescale`` rule's ``max_workers``
+    (docs/CONTROL.md) — the controller then moves ``n_active`` at epoch
+    barriers, and a crash-restore replays routing decisions at the width
+    the snapshot pinned (``state_attrs``)."""
 
     quarantine_exempt = True    # framework shell: errors here fail fast
     shed_safe = True            # farm head: shedding drops raw stream rows
-    recoverable = True          # only the round-robin cursor is state
-    state_attrs = ("_rr",)
+    recoverable = True          # round-robin cursor + active width
+    state_attrs = ("_rr", "n_active")
 
     def __init__(self, n_dest: int, routing=None, name="emitter"):
         super().__init__(name)
         self.n_dest = n_dest
+        self.n_active = n_dest
         self.routing = routing  # vectorised fn(keys, n) -> dest indices
         self._rr = 0
 
     def svc(self, batch, channel=0):
-        if self.n_dest == 1:
+        n = self.n_active
+        if n == 1:
             self.emit_to(0, batch)
             return
         if self.routing is None:
             # round-robin whole chunks: preserves per-key order only within a
             # replica, exactly like the reference's per-tuple round-robin
             self.emit_to(self._rr, batch)
-            self._rr = (self._rr + 1) % self.n_dest
+            self._rr = (self._rr + 1) % n
             return
-        dest = np.asarray(self.routing(batch["key"], self.n_dest))
+        dest = np.asarray(self.routing(batch["key"], n))
         if len(batch) and (dest[0] == dest[-1]) and not np.any(dest != dest[0]):
             self.emit_to(int(dest[0]), batch)
             return
-        for d in range(self.n_dest):
+        for d in range(n):
             sub = batch[dest == d]
             if len(sub):
                 self.emit_to(d, sub)

@@ -90,15 +90,24 @@ class Inbox:
         if pol is None:
             self._blocking(lambda: self._q.put((src, item), timeout=0.05))
         elif pol.shed == "shed_newest":
-            try:
-                self._q.put_nowait((src, item))
-            except queue.Full:
+            lim = pol.soft_limit
+            if lim is not None and self._q.qsize() >= lim:
+                # adaptive soft limit (control plane, docs/CONTROL.md):
+                # start dropping before the queue is hard-full
                 if self._cancelled():
-                    # shed_newest never blocks, so this is the only spot
-                    # a producer can observe a failed graph — without it
-                    # an unbounded source would generate forever
                     raise _Cancelled() from None
                 self._record_shed()
+            else:
+                try:
+                    self._q.put_nowait((src, item))
+                except queue.Full:
+                    if self._cancelled():
+                        # shed_newest never blocks, so this is the only
+                        # spot a producer can observe a failed graph —
+                        # without it an unbounded source would generate
+                        # forever
+                        raise _Cancelled() from None
+                    self._record_shed()
         elif pol.shed == "shed_oldest":
             self._put_shed_oldest(src, item)
         else:  # block with a deadline
@@ -115,11 +124,17 @@ class Inbox:
 
     def _put_shed_oldest(self, src: int, item):
         while True:
-            try:
-                return self._q.put_nowait((src, item))
-            except queue.Full:
-                if self._cancelled():
-                    raise _Cancelled() from None
+            lim = self._policy.soft_limit
+            if lim is None or self._q.qsize() < lim:
+                try:
+                    return self._q.put_nowait((src, item))
+                except queue.Full:
+                    if self._cancelled():
+                        raise _Cancelled() from None
+            elif self._cancelled():
+                # at/above the adaptive soft limit: evict before
+                # admitting, exactly the full-queue path below
+                raise _Cancelled() from None
             # evict the head to admit the new item.  EOS frames must
             # survive: re-queue them at the tail (safe — EOS is its
             # channel's LAST frame, so per-channel order is preserved)
@@ -182,6 +197,7 @@ class NativeInbox:
     def __init__(self, capacity: int, failed: threading.Event = None,
                  lib=None, policy: OverloadPolicy = None):
         self._lib = lib
+        self._failed = failed
         self._h = lib.wf_queue_new(capacity)
         self._items = {}
         self._seq = 0
@@ -229,13 +245,22 @@ class NativeInbox:
         if pol is None:
             self._push(src, item)
         elif pol.shed == "shed_newest":
-            slot = self._slot_for(item)
-            rc = self._lib.wf_queue_try_push(self._h, src, slot)
-            if rc != 0:
-                self._items.pop(slot, None)
-                if rc < 0:
+            lim = pol.soft_limit
+            if lim is not None and len(self._items) >= lim:
+                # adaptive soft limit (see Inbox.put): drop before full.
+                # This path never touches the ring, so it must observe a
+                # failed graph itself or an unbounded source spins forever
+                if self._failed is not None and self._failed.is_set():
                     raise _Cancelled()
                 self._record_shed()
+            else:
+                slot = self._slot_for(item)
+                rc = self._lib.wf_queue_try_push(self._h, src, slot)
+                if rc != 0:
+                    self._items.pop(slot, None)
+                    if rc < 0:
+                        raise _Cancelled()
+                    self._record_shed()
         elif pol.shed == "shed_oldest":
             self._put_shed_oldest(src, self._slot_for(item))
         else:  # block with a deadline
@@ -266,12 +291,15 @@ class NativeInbox:
         vsrc = ctypes.c_longlong()
         vslot = ctypes.c_longlong()
         while True:
-            rc = lib.wf_queue_try_push(self._h, src, slot)
-            if rc == 0:
-                return
-            if rc < 0:
-                self._items.pop(slot, None)
-                raise _Cancelled()
+            lim = self._policy.soft_limit
+            if lim is None or len(self._items) < lim + 1:
+                # +1: our own slot already sits in the side table
+                rc = lib.wf_queue_try_push(self._h, src, slot)
+                if rc == 0:
+                    return
+                if rc < 0:
+                    self._items.pop(slot, None)
+                    raise _Cancelled()
             # full: evict the head to admit the new item (EOS survives —
             # re-queued at the tail, see Inbox._put_shed_oldest)
             rc2 = lib.wf_queue_try_pop(self._h, ctypes.byref(vsrc),
@@ -339,7 +367,7 @@ class Dataflow:
     def __init__(self, name: str = "dataflow", capacity: int = 16,
                  trace_dir: str = None, overload: OverloadPolicy = None,
                  metrics=None, sample_period: float = None,
-                 recovery=None, check: str = None):
+                 recovery=None, check: str = None, control=None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
@@ -374,6 +402,34 @@ class Dataflow:
         if check not in self.CHECK_MODES:
             raise ValueError(f"check= wants one of {self.CHECK_MODES}, "
                              f"got {check!r}")
+        # `control` (control/policy.ControlPolicy) opts the graph into the
+        # closed-loop control plane (docs/CONTROL.md): a controller fed by
+        # the observability sampler drives elastic rescale, adaptive
+        # shedding, and source admission.  None = seed behavior, and the
+        # control package is never imported (same contract as check=).
+        if control is not None:
+            from ..control.policy import ControlPolicy
+            if not isinstance(control, ControlPolicy):
+                raise TypeError(f"control= wants a ControlPolicy, got "
+                                f"{type(control).__name__}")
+            if control.has_rescale and recovery is None:
+                # a rescale seals at an epoch barrier; without recovery=
+                # no source ever injects a marker, so the rule could
+                # never fire — refuse the silently-inert pair outright
+                # (check/ reports it as WF211 on a not-yet-built
+                # MultiPipe, mirroring the WF208 split)
+                raise ValueError(
+                    f"[WF211] Dataflow {name!r}: control= has Rescale "
+                    f"rules but recovery= is unset — live rescale seals "
+                    f"at epoch barriers, which only a RecoveryPolicy's "
+                    f"epoch triggers inject (docs/CONTROL.md)")
+        self.control = control
+        self._controller = None
+        #: rescalable-farm registry stamped by runtime/farm.py at wiring
+        #: time: {"pattern", "rule", "emitter", "workers", "width"} per
+        #: farm a Rescale rule targets (inert metadata when control is
+        #: unset — nothing reads it)
+        self._farms: list[dict] = []
         self.name = name
         self.capacity = capacity
         self.trace_dir = trace_dir or default_trace_dir()
@@ -421,6 +477,18 @@ class Dataflow:
         else:
             self.metrics = None
             self.events = None
+        if control is not None and self.metrics is None:
+            # the controller's only sensor is the sampler (obs/sampler.py
+            # subscription); with neither metrics= nor sample_period= it
+            # never receives a snapshot and every rule is silently inert
+            # — the WF207 shape of silent no-op, warned once here and
+            # reported by check/ as WF209 (docs/CHECKS.md)
+            import warnings
+            warnings.warn(
+                f"[WF209] Dataflow {name!r}: control= is set but neither "
+                f"metrics= nor sample_period= is — the controller is "
+                f"blind (no sampler snapshots) and no rule will ever "
+                f"fire", stacklevel=2)
         self.nodes: list[Node] = []
         self._inboxes: dict[int, Inbox] = {}
         self._edges: list[tuple[Node, Node]] = []
@@ -646,6 +714,12 @@ class Dataflow:
                 # would re-block on the same saturated downstream)
                 raise
             except Exception as e:
+                if getattr(e, "wf_no_restart", False):
+                    # e.g. a failed rescale migration (control/rescale.py)
+                    # left SIBLING workers' state inconsistent: restoring
+                    # this node alone cannot fix the farm — fail the
+                    # graph like the seed engine
+                    raise
                 if not self._supervisor.authorize_restart(node, rec, e):
                     raise
                 restoring = True
@@ -793,6 +867,13 @@ class Dataflow:
             for src, item in early:
                 self._apply_held(node, rec, events, src, item)
             self._checkpoint_node(node, rec, events, epoch)
+            hook = node._ctl_epoch_hook
+            if hook is not None:
+                # control plane (docs/CONTROL.md): a pending live rescale
+                # seals HERE — after the snapshot committed and the
+                # marker went downstream, before any post-barrier item
+                # processes, so the migration cut is exactly this epoch
+                hook(epoch)
             if events is not None:
                 events.emit("epoch", dataflow=self.name,
                             node=node.name, epoch=epoch)
@@ -810,6 +891,13 @@ class Dataflow:
             if out is not None and len(out):
                 node.emit(out)
         if epoch > 0:
+            pre = node._ctl_seal_hook
+            if pre is not None:
+                # control plane: a farm emitter ANNOUNCES a pending
+                # rescale's seal epoch before the marker leaves, so a
+                # worker racing ahead on the marker always finds the
+                # seal already published (control/rescale.py)
+                pre(epoch)
             # forward the barrier BEFORE committing, so the snapshot's
             # output sequence counters include the marker — a restored
             # node's first re-emission must not collide with the
@@ -876,6 +964,14 @@ class Dataflow:
             from ..recovery.supervisor import Supervisor
             self._supervisor = Supervisor(self, self.recovery)
             self._supervisor.attach_all()
+        if (self.control is not None and self._controller is None
+                and self.metrics is not None):
+            # after the supervisor (rescale validation needs the
+            # NodeRecovery records), before any thread (the controller
+            # wraps source emission and installs epoch hooks)
+            from ..control.controller import Controller
+            self._controller = Controller(self, self.control)
+            self._controller.attach()
         if self.events is not None:
             self.events.emit("dataflow_start", dataflow=self.name,
                              nodes=len(self.nodes),
@@ -885,9 +981,16 @@ class Dataflow:
                                  name=f"{self.name}/{node.name}", daemon=True)
             self._threads.append(t)
             t.start()
-        if self.sample_period is not None and self._sampler is None:
+        period = self.sample_period
+        if period is None and self._controller is not None:
+            # control without an explicit cadence: the sampler is the
+            # controller's sensor bus, so run it at the policy's period
+            period = self.control.period
+        if period is not None and self._sampler is None:
             from ..obs.sampler import Sampler
-            self._sampler = Sampler(self, self.sample_period)
+            self._sampler = Sampler(self, period)
+            if self._controller is not None:
+                self._sampler.subscribe(self._controller.on_sample)
             self._sampler.start()
 
     def wait(self, timeout: float = None):
@@ -926,6 +1029,10 @@ class Dataflow:
             if self._sampler is not None:
                 self._sampler.stop()   # takes the final flush sample
                 self._sampler = None
+            if self._controller is not None:
+                # restore controller-tuned knobs on user-owned policy
+                # objects (idempotent; controller.py close())
+                self._controller.close()
             if self._supervisor is not None:
                 # flush pending checkpoint blobs — briefly on the
                 # timeout path, so wait(timeout=) keeps its bound

@@ -38,6 +38,61 @@ def _apply_error_budget(pattern, replicas: list[Node]) -> list[Node]:
     return replicas
 
 
+def _provision_rescale(df: Dataflow, pattern) -> int | None:
+    """Control-plane pre-provisioning (docs/CONTROL.md): when a
+    ``Rescale`` rule targets this pattern, widen its worker set to the
+    rule's ``max_workers`` at build time — the engine graph is fixed once
+    ``run()`` starts, so elasticity means building the ceiling and
+    routing over an *active* subset (emitters' ``n_active``).  Returns
+    the initial active width (the pattern's declared parallelism), or
+    None when no rule applies."""
+    ctl = getattr(df, "control", None)
+    rule = (ctl.rescale_for(getattr(pattern, "name", None))
+            if ctl is not None else None)
+    if rule is None:
+        return None
+    if getattr(df, "metrics", None) is None:
+        # blind control (WF209): the engine never attaches a Controller,
+        # so pre-provisioned spare workers could never activate — build
+        # the farm at its declared width instead of parking idle threads
+        return None
+    if getattr(pattern, "routing", None) is None:
+        raise ValueError(
+            f"[WF210] Rescale rule targets {pattern.name!r}, which is "
+            f"not key-partitioned (no keyed routing): live rescale "
+            f"migrates per-key state between workers, and a "
+            f"window-parallel farm's workers own window slices, not "
+            f"keys — wrap the computation in a Key_Farm "
+            f"(docs/CONTROL.md)")
+    if getattr(pattern, "recoverable", None) is False:
+        raise ValueError(
+            f"[WF210] Rescale rule targets {pattern.name!r}, whose "
+            f"recoverable flag is opted out: a pattern that cannot "
+            f"snapshot cannot seal the migration cut — drop the "
+            f"opt-out or the rule (docs/CONTROL.md)")
+    if getattr(pattern, "n_emitters", 1) > 1:
+        raise ValueError(
+            f"Rescale rule targets multi-emitter farm {pattern.name!r}: "
+            f"ordered multi-emitter merges pin the channel count at "
+            f"build time and cannot rescale")
+    n0 = getattr(pattern, "_ctl_width0", None)
+    if n0 is None:
+        n0 = pattern.parallelism
+        pattern._ctl_width0 = n0
+    # validated on EVERY build, stamped or not: a pattern reused under a
+    # different rule must not route n_active past the new ceiling
+    if not rule.min_workers <= n0 <= rule.max_workers:
+        raise ValueError(
+            f"{pattern.name!r}: declared parallelism {n0} outside "
+            f"the Rescale rule's [{rule.min_workers}, "
+            f"{rule.max_workers}] range")
+    # widen for THIS build only — add_farm restores the declared width
+    # after wiring, so the user's pattern object is not permanently
+    # mutated (a later control-less build must not inherit the ceiling)
+    pattern.parallelism = rule.max_workers
+    return n0
+
+
 def add_farm(df: Dataflow, pattern, upstreams: list[Node],
              emitter: Node = DEFAULT, collector: Node = DEFAULT) -> list[Node]:
     """Instantiate `pattern` as emitter -> replicas -> collector, feeding it
@@ -80,21 +135,46 @@ def add_farm(df: Dataflow, pattern, upstreams: list[Node],
                 df.connect(r, collector)
             return [collector]
         return replicas
-    replicas = _apply_error_budget(pattern, pattern.replicas())
-    for r in replicas:
-        df.add(r)
-    if emitter is DEFAULT:
-        emitter = pattern.emitter()
-        # a 1-replica unrouted farm needs no emitter thread: the engine's
-        # multi-in inboxes merge upstreams at the replica directly
-        if (emitter is not None and type(emitter).__name__ == "StandardEmitter"
-                and pattern.parallelism == 1):
-            emitter = None
-    if collector is DEFAULT:
-        collector = pattern.collector()
-        if (collector is not None and type(collector).__name__ == "Collector"
-                and pattern.parallelism == 1):
-            collector = None
+    rescale_width = _provision_rescale(df, pattern)
+    try:
+        replicas = _apply_error_budget(pattern, pattern.replicas())
+        for r in replicas:
+            df.add(r)
+        if emitter is DEFAULT:
+            emitter = pattern.emitter()
+            # a 1-replica unrouted farm needs no emitter thread: the
+            # engine's multi-in inboxes merge upstreams at the replica
+            # directly
+            if (emitter is not None
+                    and type(emitter).__name__ == "StandardEmitter"
+                    and pattern.parallelism == 1):
+                emitter = None
+        if rescale_width is not None:
+            if emitter is None or not hasattr(emitter, "n_active"):
+                raise ValueError(
+                    f"Rescale rule targets {pattern.name!r} but its farm "
+                    f"has no routing emitter to move the active width on")
+            emitter.n_active = rescale_width
+            df._farms.append({
+                "pattern": pattern, "emitter": emitter,
+                "workers": replicas,
+                "rule": df.control.rescale_for(pattern.name),
+                "width": rescale_width,
+            })
+        if collector is DEFAULT:
+            collector = pattern.collector()
+            if (collector is not None
+                    and type(collector).__name__ == "Collector"
+                    and pattern.parallelism == 1):
+                collector = None
+    finally:
+        if rescale_width is not None:
+            # the widening was for shell/replica construction only (the
+            # emitter/collector fuse checks above must see the ceiling):
+            # hand the user's pattern object back at its declared width
+            # on EVERY exit, so neither a later control-less build nor a
+            # failed one inherits max_workers
+            pattern.parallelism = rescale_width
     if emitter is not None:
         df.add(emitter)
         for up in upstreams:

@@ -107,6 +107,16 @@ class Node:
     #: default) keeps emit()/emit_to() on the seed path — the single
     #: dead branch the recovery contract allows on the hot path
     _recov = None
+    #: control-plane epoch hooks (control/rescale.py), installed by the
+    #: Controller when ``control=`` is set.  ``_ctl_seal_hook`` runs
+    #: just before a completed barrier's marker forwards (the farm
+    #: emitter announces a pending rescale's seal epoch there);
+    #: ``_ctl_epoch_hook`` runs after the barrier checkpoint committed —
+    #: the point the rescale migration actually seals at.  Both are
+    #: checked once per EPOCH (engine ``_checkpoint_node`` /
+    #: ``_complete_barriers``), never on the per-item path.
+    _ctl_seal_hook = None
+    _ctl_epoch_hook = None
 
     def __init__(self, name: str = None):
         self.name = name or type(self).__name__

@@ -54,6 +54,13 @@ class OverloadPolicy:
         Seconds a blocking ``put`` may wait before raising
         :class:`OverloadError`.  Only meaningful with ``shed="block"``
         (the shedding policies never block).  ``None`` = wait forever.
+    soft_limit:
+        Occupancy at which the shedding disciplines start dropping,
+        *below* the hard inbox capacity.  ``None`` (default) = shed only
+        when full, exactly the pre-control behavior.  Only meaningful
+        with a shedding policy; read per ``put``, so the control plane's
+        :class:`~windflow_tpu.control.policy.AdaptiveShed` rule moves it
+        at runtime (a single attribute store — atomic under the GIL).
     error_budget:
         Default per-node poison-tuple allowance: how many ``svc``
         exceptions a node may quarantine to the dead-letter queue before
@@ -63,10 +70,10 @@ class OverloadPolicy:
         overrides this default.
     """
 
-    __slots__ = ("shed", "put_deadline", "error_budget")
+    __slots__ = ("shed", "put_deadline", "error_budget", "soft_limit")
 
     def __init__(self, shed: str = "block", put_deadline: float = None,
-                 error_budget: int = 0):
+                 error_budget: int = 0, soft_limit: int = None):
         if shed not in SHED_POLICIES:
             raise ValueError(
                 f"shed={shed!r}: must be one of {SHED_POLICIES}")
@@ -81,9 +88,18 @@ class OverloadPolicy:
                     f"(shed={shed!r} never blocks)")
         if error_budget < 0:
             raise ValueError("error_budget must be >= 0")
+        if soft_limit is not None:
+            if int(soft_limit) < 1:
+                raise ValueError("soft_limit must be >= 1 item (None to "
+                                 "shed only when full)")
+            if shed == "block":
+                raise ValueError(
+                    "soft_limit only applies to the shedding policies "
+                    "(shed='block' has no drop point to move)")
         self.shed = shed
         self.put_deadline = put_deadline
         self.error_budget = int(error_budget)
+        self.soft_limit = None if soft_limit is None else int(soft_limit)
 
     @property
     def reshapes_put(self) -> bool:
@@ -94,7 +110,8 @@ class OverloadPolicy:
     def __repr__(self):
         return (f"OverloadPolicy(shed={self.shed!r}, "
                 f"put_deadline={self.put_deadline}, "
-                f"error_budget={self.error_budget})")
+                f"error_budget={self.error_budget}, "
+                f"soft_limit={self.soft_limit})")
 
 
 class DeadLetter:
