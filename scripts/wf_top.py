@@ -22,8 +22,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _COLS = ("NODE", "DEPTH", "HWM", "BATCH/S", "TUPLES/S", "EWMA_US",
-         "SHED", "QUAR")
-_W = (22, 6, 6, 10, 12, 9, 8, 6)
+         "Q95_US", "S95_US", "SHED", "QUAR")
+_W = (22, 6, 6, 10, 12, 9, 9, 9, 8, 6)
 
 
 def read_samples(path, offset=0):
@@ -107,6 +107,11 @@ def render(cur, prev, events=(), clock=time.localtime):
                f"{tr:.0f}" if tr is not None else "-",
                f"{n['ewma_service_us_per_batch']:.1f}"
                if "ewma_service_us_per_batch" in n else "-",
+               # span-tracer latency fields (docs/OBSERVABILITY.md
+               # §tracing); absent on untraced graphs and on pre-trace
+               # metrics.jsonl lines — render "-" either way
+               f"{n['q_p95_us']:.1f}" if "q_p95_us" in n else "-",
+               f"{n['svc_p95_us']:.1f}" if "svc_p95_us" in n else "-",
                str(n["shed"]), str(n["quarantined"]))
         lines.append("  ".join(c.ljust(w) if i == 0 else c.rjust(w)
                                for i, (c, w) in enumerate(zip(row, _W))))

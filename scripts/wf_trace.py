@@ -1,0 +1,311 @@
+"""wf_trace — latency attribution from sampled spans, and Perfetto export.
+
+Reads the ``trace.jsonl`` the span tracer appends (``Dataflow(trace=
+TracePolicy(...))``, docs/OBSERVABILITY.md §tracing) and answers "p95
+tripled — WHICH stage?" two ways:
+
+* the default text report: per-stage queue-wait / service p50/p95/p99
+  over the sampled hops, the end-to-end distribution per trace, the
+  device-launch phase breakdown (``device_put`` / ``dispatch`` /
+  ``harvest_wait`` child spans), and the control-plane span counts;
+* ``--chrome out.json``: Chrome trace-event JSON — open it in Perfetto
+  (https://ui.perfetto.dev) or ``chrome://tracing``.  Every sampled
+  batch renders as a queue slice + service slice on its node's track,
+  device launches as child slices, and epoch/checkpoint/rescale as
+  instant events (both the tracer's ``ctrl`` spans and, when an
+  ``events.jsonl`` sits beside the trace, the engine's recovery/control
+  events).
+
+    WF_LOG_DIR=/tmp/wf python my_job.py        # with trace= set
+    python scripts/wf_trace.py /tmp/wf                 # text report
+    python scripts/wf_trace.py /tmp/wf --json          # machine-readable
+    python scripts/wf_trace.py /tmp/wf --chrome t.json # Perfetto
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: event-log kinds worth a timeline instant (docs/OBSERVABILITY.md)
+_INSTANT_EVENTS = ("epoch", "checkpoint", "rescale")
+
+
+def read_records(path):
+    """Parse trace.jsonl; returns a list of span dicts (torn tail lines,
+    from a still-running writer, are skipped)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            if not line.endswith("\n"):
+                break
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+def read_events(path):
+    """epoch/checkpoint/rescale lines of an events.jsonl (empty list
+    when the file is absent)."""
+    if not path or not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            if not line.endswith("\n"):
+                break
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("event") in _INSTANT_EVENTS:
+                out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------- summary
+
+def _pcts(values):
+    if not len(values):
+        return {}
+    a = np.asarray(values, dtype=np.float64)
+    p50, p95, p99 = np.percentile(a, (50, 95, 99))
+    return {"mean": float(a.mean()), "p50": float(p50),
+            "p95": float(p95), "p99": float(p99)}
+
+
+def summarize(records):
+    """Aggregate span records into the report dict (pure: testable)."""
+    hops = [r for r in records if r.get("kind") == "hop"]
+    launches = [r for r in records if r.get("kind") == "launch"]
+    ctrls = [r for r in records if r.get("kind") == "ctrl"]
+    stages = {}
+    traces = {}
+    for s in hops:
+        st = stages.setdefault(s["node"], {"q_us": [], "svc_us": [],
+                                           "end_us": [], "rows": 0})
+        st["q_us"].append(s["q_us"])
+        st["svc_us"].append(s["svc_us"])
+        st["end_us"].append(s["end_us"])
+        st["rows"] += s.get("rows", 0)
+        tr = traces.setdefault((s["dataflow"], s["trace"]),
+                               {"end_us": 0.0, "hops": 0})
+        tr["end_us"] = max(tr["end_us"], s["end_us"])
+        tr["hops"] += 1
+    # stage order: median completion offset approximates topology order
+    order = sorted(stages,
+                   key=lambda n: float(np.median(stages[n]["end_us"])))
+    stage_rows = [{"node": name, "n": len(stages[name]["q_us"]),
+                   "queue_us": _pcts(stages[name]["q_us"]),
+                   "svc_us": _pcts(stages[name]["svc_us"])}
+                  for name in order]
+    phases = {}
+    for rec in launches:
+        phases.setdefault(rec.get("phase", "?"), []).append(rec["dur_us"])
+    rep = {"n_spans": len(records), "n_hops": len(hops),
+           "n_traces": len(traces), "stages": stage_rows,
+           "end_to_end_us": _pcts([t["end_us"] for t in traces.values()]),
+           "launch_phases": {p: dict(_pcts(v), n=len(v))
+                             for p, v in sorted(phases.items())},
+           "ctrl": {}}
+    for rec in ctrls:
+        key = rec.get("name", "?")
+        cur = rep["ctrl"].setdefault(key, {"n": 0, "dur_us": 0.0})
+        cur["n"] += 1
+        cur["dur_us"] += rec.get("dur_us", 0.0)
+    if stage_rows and rep["end_to_end_us"].get("mean"):
+        worst = max(stage_rows, key=lambda s: (s["queue_us"]["mean"]
+                                               + s["svc_us"]["mean"]))
+        rep["critical_stage"] = worst["node"]
+        q_mean = sum(s["queue_us"]["mean"] for s in stage_rows)
+        c_mean = sum(s["svc_us"]["mean"] for s in stage_rows)
+        total = max(rep["end_to_end_us"]["mean"], q_mean + c_mean)
+        rep["shares"] = {"queue": round(q_mean / total, 4),
+                         "compute": round(c_mean / total, 4),
+                         "launch_async": round(
+                             max(total - q_mean - c_mean, 0.0) / total, 4)}
+    return rep
+
+
+def _fmt_us(v):
+    return f"{v / 1e3:8.2f}" if v is not None else "       -"
+
+
+def render(rep):
+    lines = [f"wf_trace  spans={rep['n_spans']}  hops={rep['n_hops']}  "
+             f"traces={rep['n_traces']}"]
+    if not rep["n_hops"]:
+        lines.append("no hop spans recorded (was trace= set, with a "
+                     "trace dir?)")
+        return "\n".join(lines)
+    lines.append("")
+    lines.append(f"{'STAGE':<30} {'N':>6}  {'Q_P50':>8} {'Q_P95':>8} "
+                 f"{'Q_P99':>8}  {'S_P50':>8} {'S_P95':>8} {'S_P99':>8}"
+                 f"   (ms)")
+    for s in rep["stages"]:
+        q, v = s["queue_us"], s["svc_us"]
+        lines.append(
+            f"{s['node']:<30} {s['n']:>6}  {_fmt_us(q['p50'])} "
+            f"{_fmt_us(q['p95'])} {_fmt_us(q['p99'])}  {_fmt_us(v['p50'])} "
+            f"{_fmt_us(v['p95'])} {_fmt_us(v['p99'])}")
+    e2e = rep["end_to_end_us"]
+    lines.append("")
+    lines.append(f"end-to-end (ms): p50={e2e['p50'] / 1e3:.2f}  "
+                 f"p95={e2e['p95'] / 1e3:.2f}  p99={e2e['p99'] / 1e3:.2f}"
+                 f"  over {rep['n_traces']} sampled batches")
+    if "shares" in rep:
+        sh = rep["shares"]
+        lines.append(f"share: queue={100 * sh['queue']:.0f}%  "
+                     f"compute={100 * sh['compute']:.0f}%  "
+                     f"launch/async={100 * sh['launch_async']:.0f}%"
+                     f"   critical stage: {rep['critical_stage']}")
+    for phase, st in rep["launch_phases"].items():
+        lines.append(f"launch {phase:<14} n={st['n']:<6} "
+                     f"p50={st['p50'] / 1e3:.3f} ms  "
+                     f"p95={st['p95'] / 1e3:.3f} ms")
+    for name, st in sorted(rep["ctrl"].items()):
+        lines.append(f"ctrl {name:<16} n={st['n']:<6} "
+                     f"total={st['dur_us'] / 1e3:.2f} ms")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------- Perfetto
+
+def chrome_trace(records, events=()) -> dict:
+    """Convert span records (+ optional events.jsonl instants) into
+    Chrome trace-event JSON (the object form Perfetto and
+    chrome://tracing load).  Timestamps are the records' wall-clock
+    ``t`` in microseconds; a hop renders as a queue slice + service
+    slice (ph ``X``) on its node's thread track, launches as child
+    slices, ctrl spans and recovery/control events as process-scoped
+    instants (ph ``i``), and each trace carries flow arrows (ph
+    ``s``/``t``) from source to sink."""
+    pids = {}          # dataflow -> pid
+    tids = {}          # (dataflow, node) -> tid
+    ev = []
+
+    def _pid(df):
+        p = pids.get(df)
+        if p is None:
+            p = pids[df] = len(pids) + 1
+            ev.append({"ph": "M", "pid": p, "name": "process_name",
+                       "args": {"name": df}})
+        return p
+
+    def _tid(df, node):
+        key = (df, node)
+        t = tids.get(key)
+        if t is None:
+            t = tids[key] = sum(1 for k in tids if k[0] == df) + 1
+            ev.append({"ph": "M", "pid": _pid(df), "tid": t,
+                       "name": "thread_name", "args": {"name": node}})
+        return t
+
+    for r in records:
+        kind = r.get("kind")
+        df = r.get("dataflow", "?")
+        node = r.get("node") or "?"
+        t_us = r["t"] * 1e6
+        pid, tid = _pid(df), _tid(df, node)
+        args = {k: r[k] for k in ("trace", "span", "parent", "rows",
+                                  "end_us") if r.get(k) is not None}
+        if kind == "hop":
+            ts_svc = t_us - r["svc_us"]
+            if r["q_us"] or r["svc_us"]:
+                ev.append({"ph": "X", "pid": pid, "tid": tid,
+                           "ts": ts_svc - r["q_us"], "dur": r["q_us"],
+                           "name": "queue", "cat": "queue",
+                           "args": args})
+                ev.append({"ph": "X", "pid": pid, "tid": tid,
+                           "ts": ts_svc, "dur": max(r["svc_us"], 1.0),
+                           "name": "svc", "cat": "service",
+                           "args": args})
+            # flow arrows stitch the trace across tracks/processes:
+            # a start at the root hop, steps at every later hop
+            ev.append({"ph": "s" if r.get("parent") is None else "t",
+                       "pid": pid, "tid": tid, "ts": ts_svc,
+                       "id": r["trace"], "name": "trace",
+                       "cat": "trace"})
+        elif kind == "launch":
+            args["phase"] = r.get("phase")
+            ev.append({"ph": "X", "pid": pid, "tid": tid,
+                       "ts": t_us - r["dur_us"], "dur": r["dur_us"],
+                       "name": r.get("phase", "launch"), "cat": "launch",
+                       "args": args})
+        elif kind == "ctrl":
+            ev.append({"ph": "i", "s": "p", "pid": pid, "tid": tid,
+                       "ts": t_us,
+                       "name": f"{r.get('name', 'ctrl')} "
+                               f"e{r.get('epoch', '?')}",
+                       "cat": "ctrl",
+                       "args": {k: v for k, v in r.items()
+                                if k not in ("t", "kind")}})
+    for rec in events:
+        df = rec.get("dataflow", "?")
+        pid = _pid(df)
+        tid = _tid(df, rec.get("node") or rec.get("farm") or "engine")
+        name = rec["event"]
+        if "epoch" in rec:
+            name = f"{name} e{rec['epoch']}"
+        ev.append({"ph": "i", "s": "p", "pid": pid, "tid": tid,
+                   "ts": rec["t"] * 1e6, "name": name, "cat": "event",
+                   "args": {k: v for k, v in rec.items()
+                            if k not in ("t", "event")}})
+    return {"traceEvents": ev, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="trace dir (WF_LOG_DIR) or a "
+                                 "trace.jsonl file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary report as one JSON object")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="write Chrome trace-event JSON for Perfetto "
+                         "('-' = stdout)")
+    a = ap.parse_args(argv)
+
+    path = a.path
+    if os.path.isdir(path):
+        ev_path = os.path.join(path, "events.jsonl")
+        path = os.path.join(path, "trace.jsonl")
+    else:
+        ev_path = os.path.join(os.path.dirname(path), "events.jsonl")
+    if not os.path.exists(path):
+        print(f"wf_trace: no spans at {path} (run with trace= and a "
+              f"trace dir set — trace_dir= or WF_LOG_DIR)",
+              file=sys.stderr)
+        return 2
+    records = read_records(path)
+    if a.chrome:
+        doc = chrome_trace(records, read_events(ev_path))
+        if a.chrome == "-":
+            json.dump(doc, sys.stdout)
+            sys.stdout.write("\n")
+        else:
+            with open(a.chrome, "w") as f:
+                json.dump(doc, f)
+            print(f"wf_trace: wrote {len(doc['traceEvents'])} events to "
+                  f"{a.chrome} (open in https://ui.perfetto.dev)")
+        return 0
+    rep = summarize(records)
+    if a.json:
+        print(json.dumps(rep))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout went away (| head); not an error worth a traceback
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

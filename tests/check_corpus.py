@@ -23,7 +23,7 @@ SCHEMA = Schema(value=np.int64)
 
 #: WF### ids the CLI run over this module must report
 PLANTED = ("WF102", "WF103", "WF204", "WF205", "WF207", "WF208",
-           "WF301")
+           "WF213", "WF301")
 
 #: module-level scan target: heartbeat at/above the stall timeout
 BAD_WIRE = WireConfig(heartbeat=5.0, stall_timeout=2.0)   # -> WF205
@@ -66,6 +66,14 @@ def _recovery_pipe() -> MultiPipe:
             .chain_sink(Sink(lambda b: None, vectorized=True)))
 
 
+def _trace_pipe() -> MultiPipe:
+    """WF213: span tracing with no trace_dir (spans stay ring-only)."""
+    from windflow_tpu.obs.trace import TracePolicy
+    return (MultiPipe("corpus_trace", trace=TracePolicy(sample_rate=0.5))
+            .add_source(Source(_src, SCHEMA))
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+
+
 def _race_pipe() -> MultiPipe:
     """WF301: parallel replicas mutating closed-over shared state."""
     counts = [0]
@@ -81,4 +89,4 @@ def _race_pipe() -> MultiPipe:
 
 def wf_check_pipelines():
     return [_window_pipe(), _overload_pipe(), _recovery_pipe(),
-            _race_pipe(), BAD_WIRE]
+            _trace_pipe(), _race_pipe(), BAD_WIRE]

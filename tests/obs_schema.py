@@ -37,6 +37,31 @@ NODE_OPTIONAL_FIELDS = {
     "rcv_tuples": (int,),
     "ewma_service_us_per_batch": (int, float),
     "avg_service_us_per_batch": (int, float),
+    # span-tracing latency fields (obs/trace.py; only on traced graphs)
+    "q_p50_us": (int, float),
+    "q_p95_us": (int, float),
+    "q_p99_us": (int, float),
+    "svc_p50_us": (int, float),
+    "svc_p95_us": (int, float),
+    "svc_p99_us": (int, float),
+}
+
+#: span-record kinds (trace.jsonl, obs/trace.py) -> kind-specific
+#: required fields; the common fields are checked for every kind
+SPAN_COMMON_FIELDS = {
+    "t": (float,),
+    "kind": (str,),
+    "span": (int,),
+    "dataflow": (str,),
+}
+SPAN_KIND_FIELDS = {
+    "hop": {"trace": (int,), "node": (str,), "q_us": (int, float),
+            "svc_us": (int, float), "end_us": (int, float),
+            "rows": (int,)},
+    "launch": {"trace": (int,), "phase": (str,), "dur_us": (int, float),
+               "end_us": (int, float)},
+    "ctrl": {"name": (str,), "node": (str,), "epoch": (int,),
+             "dur_us": (int, float)},
 }
 
 
@@ -71,6 +96,23 @@ def validate_sample(sample: dict, ctx: str = "metrics.jsonl"):
     for name, h in sample["histograms"].items():
         for field in ("buckets", "sum", "count"):
             assert field in h, f"{ctx}: histogram {name!r} missing {field}"
+
+
+def validate_span(rec: dict, ctx: str = "trace.jsonl"):
+    """One trace.jsonl span record against the documented schema
+    (docs/OBSERVABILITY.md §tracing)."""
+    for field, types in SPAN_COMMON_FIELDS.items():
+        _typed(rec, field, types, ctx)
+    kind = rec["kind"]
+    assert kind in SPAN_KIND_FIELDS, f"{ctx}: unknown span kind {kind!r}"
+    for field, types in SPAN_KIND_FIELDS[kind].items():
+        v = _typed(rec, field, types, ctx)
+        if field in ("q_us", "svc_us", "dur_us", "rows"):
+            assert v >= 0, f"{ctx}: negative {field}"
+    # parent is optional-by-None: root hops and ctrl spans carry None
+    if rec.get("parent") is not None:
+        _typed(rec, "parent", (int,), ctx)
+    json.dumps(rec)     # every field must be JSON-serialisable
 
 
 def validate_event(event: dict, ctx: str = "events.jsonl"):

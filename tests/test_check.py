@@ -198,6 +198,12 @@ def _ctl_pipe(t, *, rescale=True, recovery=True, obs=True,
     return p
 
 
+def _trace_pipe(trace_dir):
+    from windflow_tpu.obs.trace import TracePolicy
+    return _pipe(name="tr", trace=TracePolicy(sample_rate=0.5),
+                 trace_dir=trace_dir)
+
+
 _G = 0
 
 
@@ -254,6 +260,8 @@ CORPUS = {
               lambda t: _ctl_pipe(t)),
     "WF212": (lambda t: _ctl_pipe(t, target="kfarm"),
               lambda t: _ctl_pipe(t)),
+    "WF213": (lambda t: _trace_pipe(None),
+              lambda t: _trace_pipe(str(t))),
     "WF301": (lambda t: _race_pipe(guarded=False),
               lambda t: _race_pipe(guarded=True)),
     "WF302": (lambda t: _global_pipe(True),

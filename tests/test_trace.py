@@ -1,0 +1,638 @@
+"""End-to-end span tracing (windflow_tpu/obs/trace.py,
+docs/OBSERVABILITY.md §tracing):
+
+* the ``trace=`` knob contract: unset never imports the package, off
+  means byte-identical results, falsy means OFF;
+* span stitching source → sink (parentage across threads and farm
+  fan-out, device-launch child spans via the profile recorder, Comb
+  fusion, the supervised/recovery receive loop, ctrl spans);
+* wire propagation (TRACE frame, ``decode_trace``, adoption);
+* the sampler's per-node latency percentile fields and the
+  ``Rescale(up_q95_us=)`` pure-observe path;
+* ``scripts/wf_trace.py`` summary + Chrome trace-event export;
+* the expo labelled-family rendering and the profile satellites.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from obs_schema import validate_sample, validate_span
+from windflow_tpu.api import MultiPipe, union_multipipes
+from windflow_tpu.core.tuples import Schema
+from windflow_tpu.obs import MetricsRegistry
+from windflow_tpu.obs.trace import Stamped, TracePolicy, Tracer, as_policy
+from windflow_tpu.parallel.channel import RowReceiver, RowSender, TracedRows
+from windflow_tpu.patterns.basic import Map, Sink, Source
+from windflow_tpu.runtime.engine import Dataflow
+from windflow_tpu.runtime.node import Node, SourceNode
+from windflow_tpu.utils import profile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = Schema(value=np.int64)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_obs_env(monkeypatch):
+    """An ambient WF_LOG_DIR would turn ring-only graphs into writers
+    (and silence the WF213 warning these tests pin)."""
+    monkeypatch.delenv("WF_LOG_DIR", raising=False)
+    monkeypatch.delenv("WF_SAMPLE_PERIOD", raising=False)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ test graph
+
+class _Src(SourceNode):
+    def __init__(self, n=6, name="src"):
+        super().__init__(name)
+        self.n = n
+
+    def generate(self):
+        for i in range(self.n):
+            self.emit(np.arange(4, dtype=np.int64) + i)
+
+
+class _Mid(Node):
+    """Host stage that also brackets a profile span, standing in for the
+    device ship phases (ops/resident.py uses the same primitive)."""
+
+    def svc(self, batch, channel=0):
+        with profile.span("dispatch"):
+            pass
+        self.emit(batch * 2)
+
+
+class _Snk(Node):
+    def __init__(self, name="snk"):
+        super().__init__(name)
+        self.got = []
+
+    def svc(self, batch, channel=0):
+        self.got.append(batch.copy())
+
+
+def _run_linear(trace=None, trace_dir=None, metrics=None, n=6):
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # ring-only runs warn WF213
+        df = Dataflow("tr", trace_dir=trace_dir, metrics=metrics,
+                      trace=trace)
+    s = df.add(_Src(n))
+    m = df.add(_Mid("mid"))
+    k = df.add(_Snk())
+    df.connect(s, m)
+    df.connect(m, k)
+    df.run_and_wait_end()
+    return df, k
+
+
+# ---------------------------------------------------------- knob contract
+
+def test_trace_policy_validation():
+    with pytest.raises(ValueError):
+        TracePolicy(sample_rate=0.0)
+    with pytest.raises(ValueError):
+        TracePolicy(sample_rate=1.5)
+    with pytest.raises(ValueError):
+        TracePolicy(max_spans=0)
+    with pytest.raises(ValueError):
+        TracePolicy(ring=0)
+    assert TracePolicy(sample_rate=1.0).sample_every == 1
+    assert TracePolicy(sample_rate=0.01).sample_every == 100
+    assert as_policy(True).sample_every == 1
+    assert as_policy(0.5).sample_every == 2
+    pol = TracePolicy(sample_rate=0.5)
+    assert as_policy(pol) is pol
+
+
+def test_trace_falsy_means_off():
+    for falsy in (None, 0, 0.0, False):
+        df = Dataflow("off", trace=falsy)
+        assert df.tracer is None and df.trace is None
+
+
+def test_trace_unset_never_imports_package():
+    """Seed contract: trace= unset => windflow_tpu.obs.trace is never
+    imported (subprocess keeps sys.modules clean)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "from windflow_tpu.api import MultiPipe\n"
+        "from windflow_tpu.core.tuples import Schema\n"
+        "from windflow_tpu.patterns.basic import Sink, Source\n"
+        "S = Schema(value=np.int64)\n"
+        "def gen(sh):\n"
+        "    sh.push(key=0, id=0, ts=0, value=1)\n"
+        "got = []\n"
+        "p = (MultiPipe('seed')\n"
+        "     .add_source(Source(gen, S))\n"
+        "     .chain_sink(Sink(lambda b: got.append(b),"
+        " vectorized=True)))\n"
+        "p.run_and_wait_end()\n"
+        "assert any(b is not None and len(b) for b in got)\n"
+        "assert 'windflow_tpu.obs.trace' not in sys.modules, \\\n"
+        "    'obs.trace imported on the seed path'\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("WF_LOG_DIR", None)
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_trace_off_results_byte_identical():
+    _df0, k0 = _run_linear(trace=None)
+    _df1, k1 = _run_linear(trace=TracePolicy(sample_rate=1.0))
+    assert len(k0.got) == len(k1.got)
+    assert (np.concatenate(k0.got).tobytes()
+            == np.concatenate(k1.got).tobytes())
+
+
+def test_ring_only_warns_wf213():
+    with pytest.warns(UserWarning, match=r"WF213.*trace_dir"):
+        Dataflow("ringy", trace=0.5)
+
+
+# -------------------------------------------------------------- stitching
+
+def _by_span(records):
+    return {r["span"]: r for r in records}
+
+
+def test_span_stitching_source_to_sink_with_launch_children():
+    df, k = _run_linear(trace=TracePolicy(sample_rate=1.0), n=5)
+    recs = list(df.tracer.recent)
+    hops = [r for r in recs if r["kind"] == "hop"]
+    launches = [r for r in recs if r["kind"] == "launch"]
+    spans = _by_span(recs)
+    traces = {}
+    for r in hops:
+        traces.setdefault(r["trace"], []).append(r)
+    assert len(traces) == 5                     # every batch sampled
+    for recs_t in traces.values():
+        chain = sorted(recs_t, key=lambda r: r["end_us"])
+        names = [r["node"] for r in chain]
+        assert names == ["tr_00_src", "tr_01_mid", "tr_02_snk"]
+        assert chain[0]["parent"] is None       # the root
+        # each hop's parent is the upstream hop of the SAME trace
+        for up, down in zip(chain, chain[1:]):
+            assert down["parent"] == up["span"]
+        assert chain[-1]["end_us"] >= chain[0]["end_us"]
+    # the profile span inside mid.svc became a child of mid's hop
+    assert len(launches) == 5
+    for lr in launches:
+        assert lr["phase"] == "dispatch"
+        parent = spans[lr["parent"]]
+        assert parent["kind"] == "hop" and parent["node"] == "tr_01_mid"
+        assert lr["trace"] == parent["trace"]
+    # sink saw every row exactly once (tracing is observability only)
+    assert sum(len(b) for b in k.got) == 20
+
+
+def test_sampling_fraction_respected():
+    df, _k = _run_linear(trace=TracePolicy(sample_rate=0.5), n=8)
+    hops = [r for r in df.tracer.recent if r["kind"] == "hop"]
+    traces = {r["trace"] for r in hops}
+    assert len(traces) == 4                     # 1-in-2 of 8 batches
+
+
+def test_comb_fusion_propagates_context(tmp_path):
+    """chain() fuses source+map into one thread: the sampling decision
+    happens at the fused first stage, the tail wraps, and the sink hop
+    parents on the comb's root span."""
+    got = []
+
+    def gen(sh):
+        for i in range(4):
+            sh.push(key=0, id=i, ts=i, value=i)
+
+    p = (MultiPipe("fuse", trace=TracePolicy(sample_rate=1.0),
+                   trace_dir=str(tmp_path))
+         .add_source(Source(gen, SCHEMA, name="src"))
+         .chain(Map(lambda b: None, vectorized=True))
+         .add_sink(Sink(lambda b: got.append(b), vectorized=True)))
+    p.run_and_wait_end()
+    tracer = p._df.tracer
+    hops = [r for r in tracer.recent if r["kind"] == "hop"]
+    spans = _by_span(hops)
+    roots = [r for r in hops if r["parent"] is None]
+    assert roots, "no root spans recorded"
+    non_roots = [r for r in hops if r["parent"] is not None]
+    assert non_roots, "nothing downstream of the fused source"
+    for r in non_roots:
+        assert r["parent"] in spans
+        assert spans[r["parent"]]["trace"] == r["trace"]
+
+
+def test_supervised_loop_records_spans(tmp_path):
+    """recovery= + trace=: the supervised receive loop unwraps Stamped
+    payloads (inside the Tagged envelope), records hops, and the
+    checkpoint commits appear as ctrl spans."""
+    from windflow_tpu.recovery.policy import RecoveryPolicy
+    got = []
+
+    def gen(sh):
+        for i in range(8):
+            sh.push(key=i % 2, id=i, ts=i, value=i)
+            sh.flush()
+
+    p = (MultiPipe("sup", trace=TracePolicy(sample_rate=1.0),
+                   trace_dir=str(tmp_path), metrics=True,
+                   recovery=RecoveryPolicy(
+                       epoch_batches=2, checkpoint_dir=str(tmp_path)))
+         .add_source(Source(gen, SCHEMA, name="src"))
+         .add(Map(lambda b: None, vectorized=True))
+         .add_sink(Sink(lambda b: got.append(b), vectorized=True)))
+    p.run_and_wait_end()
+    tracer = p._df.tracer
+    recs = list(tracer.recent)
+    hops = [r for r in recs if r["kind"] == "hop"]
+    ctrls = [r for r in recs if r["kind"] == "ctrl"]
+    spans = _by_span(hops)
+    assert any(r["parent"] is not None for r in hops)
+    for r in hops:
+        if r["parent"] is not None and r["parent"] in spans:
+            assert spans[r["parent"]]["trace"] == r["trace"]
+    assert any(c["name"] == "checkpoint" for c in ctrls)
+    assert sum(len(b) for b in got if b is not None) == 8
+
+
+# ------------------------------------------------------------------ wire
+
+def test_wire_trace_frame_roundtrip():
+    batch = np.arange(6, dtype=np.int64).view([("value", np.int64)])
+    info = {"trace": 4242, "span": 7, "elapsed_us": 1500.0}
+    recv = RowReceiver(n_senders=1, decode_trace=True)
+    snd = RowSender(recv.host, recv.port)
+    snd.send(batch[:3], trace=info)
+    snd.send(batch[3:])             # untraced frame rides the same link
+    snd.close()
+    out = list(recv.batches())
+    assert len(out) == 2
+    traced = [b for b in out if getattr(b, "wf_trace", None) is not None]
+    plain = [b for b in out if getattr(b, "wf_trace", None) is None]
+    assert len(traced) == 1 and len(plain) == 1
+    assert traced[0].wf_trace == info
+    assert isinstance(traced[0], TracedRows)
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(out)["value"]), np.arange(6))
+
+
+def test_wire_trace_frame_discarded_by_default():
+    batch = np.arange(3, dtype=np.int64).view([("value", np.int64)])
+    recv = RowReceiver(n_senders=1)
+    snd = RowSender(recv.host, recv.port)
+    snd.send(batch, trace={"trace": 1, "span": 2, "elapsed_us": 3.0})
+    snd.close()
+    out = list(recv.batches())
+    assert len(out) == 1
+    assert getattr(out[0], "wf_trace", None) is None
+
+
+def test_source_adopts_wire_trace():
+    """A TracedRows batch emitted by a traced source joins the remote
+    trace instead of starting a fresh one: same trace id, root parented
+    on the remote span."""
+    tracer = Tracer("adoptee", TracePolicy(sample_rate=1.0))
+
+    class N(SourceNode):
+        pass
+
+    node = N("src")
+    node._trace_origin = True
+    node._hop_id = "adoptee_00_src"
+    batch = np.arange(3, dtype=np.int64).view(TracedRows)
+    batch.wf_trace = {"trace": 990011, "span": 41, "elapsed_us": 2000.0}
+    out = tracer.outgoing(batch, node)
+    assert isinstance(out, Stamped)
+    assert out.ctx.trace_id == 990011
+    roots = [r for r in tracer.recent if r["kind"] == "hop"]
+    assert roots[0]["trace"] == 990011 and roots[0]["parent"] == 41
+    # the back-dated anchor puts the local root past the remote elapsed
+    assert roots[0]["end_us"] >= 2000.0
+    tracer.close()      # balance the process-wide recorder refcount
+
+
+# ------------------------------------- sampler percentiles / control rule
+
+def test_sampler_carries_latency_percentiles():
+    from windflow_tpu.obs.sampler import Sampler
+    reg = MetricsRegistry()
+    df, _k = _run_linear(trace=TracePolicy(sample_rate=1.0), metrics=reg)
+    sample = Sampler(df, 1.0).sample()
+    validate_sample(sample, "sample")
+    by_node = {n["node"]: n for n in sample["nodes"]}
+    for field in ("q_p50_us", "q_p95_us", "q_p99_us",
+                  "svc_p50_us", "svc_p95_us", "svc_p99_us"):
+        assert field in by_node["mid"], (field, by_node["mid"])
+        assert by_node["mid"][field] >= 0
+    assert by_node["mid"]["q_p50_us"] <= by_node["mid"]["q_p99_us"]
+
+
+def test_untraced_sample_has_no_latency_fields():
+    from windflow_tpu.obs.sampler import Sampler
+    reg = MetricsRegistry()
+    df, _k = _run_linear(metrics=reg)
+    sample = Sampler(df, 1.0).sample()
+    validate_sample(sample, "sample")
+    for n in sample["nodes"]:
+        assert "q_p95_us" not in n and "svc_p95_us" not in n
+
+
+def test_rescale_rule_thresholds_on_tail_latency():
+    """Pure observe() path (ISSUE acceptance): a Rescale rule fires on
+    the q95 signal alone, and the legacy 2-tuple form stays accepted."""
+    from windflow_tpu.control.policy import Rescale
+    rule = Rescale("kf", max_workers=4, up_q95_us=50_000.0,
+                   hysteresis=2, cooldown=0.0)
+    assert rule.observe((0, 0.0, 10_000.0), 0.0) == 0
+    assert rule.observe((0, 0.0, 60_000.0), 1.0) == 0   # streak 1/2
+    assert rule.observe((0, 0.0, 75_000.0), 2.0) == 1   # fires on q95
+    # depth threshold still works through the 2-tuple form
+    rule2 = Rescale("kf", max_workers=4, up_depth=8, hysteresis=1,
+                    cooldown=0.0)
+    assert rule2.observe((9, 0.0), 0.0) == 1
+    with pytest.raises(ValueError):
+        Rescale("kf", max_workers=4, up_q95_us=0)
+
+
+# --------------------------------------------------- file sinks / bounds
+
+def test_trace_jsonl_schema_and_bound(tmp_path):
+    df, _k = _run_linear(trace=TracePolicy(sample_rate=1.0),
+                         trace_dir=str(tmp_path), n=6)
+    path = os.path.join(str(tmp_path), "trace.jsonl")
+    assert os.path.exists(path)
+    n = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            assert line.endswith("\n")
+            validate_span(json.loads(line), f"trace.jsonl:{i}")
+            n += 1
+    assert n == df.tracer.written and n > 0
+
+
+def test_max_spans_drops_and_counts(tmp_path):
+    from windflow_tpu.obs import EventLog
+    ev = EventLog()
+    reg = MetricsRegistry()
+    tracer = Tracer("cap", TracePolicy(sample_rate=1.0, max_spans=2),
+                    trace_dir=str(tmp_path), metrics=reg, events=ev)
+    from windflow_tpu.obs.trace import SpanCtx
+    from time import perf_counter_ns
+    ctx = SpanCtx(1, perf_counter_ns(), tracer)
+    for i in range(5):
+        tracer.record_hop(ctx, "n", 100 + i, None, 1000, 1000, 1)
+    tracer.close()
+    assert tracer.written == 2 and tracer.dropped == 3
+    assert tracer.spans == 5                    # ring saw everything
+    assert len(tracer.recent) == 5
+    with open(os.path.join(str(tmp_path), "trace.jsonl")) as f:
+        assert sum(1 for _ in f) == 2
+    kinds = [e["event"] for e in ev.recent]
+    assert kinds.count("trace_drop") == 1       # rate-limited
+    assert reg.counter("trace_spans_dropped").value == 3
+
+
+def test_union_trace_policies_must_agree(tmp_path):
+    def _leg(name, trace):
+        p = MultiPipe(name, trace=trace, trace_dir=str(tmp_path))
+        p.add_source(Source(lambda sh: None, SCHEMA))
+        return p
+
+    pol = TracePolicy(sample_rate=0.5)
+    merged = union_multipipes(_leg("a", pol), _leg("b", None), name="u")
+    assert merged.trace is pol
+    with pytest.raises(ValueError, match="conflicting trace"):
+        union_multipipes(_leg("c", pol),
+                         _leg("d", TracePolicy(sample_rate=0.25)),
+                         name="u2")
+
+
+# -------------------------------------------------------------- wf_trace
+
+def test_wf_trace_summary_and_chrome_export(tmp_path):
+    df, _k = _run_linear(trace=TracePolicy(sample_rate=1.0),
+                         trace_dir=str(tmp_path), n=6)
+    wf_trace = _load_script("wf_trace")
+    records = wf_trace.read_records(
+        os.path.join(str(tmp_path), "trace.jsonl"))
+    assert records
+    rep = wf_trace.summarize(records)
+    assert rep["n_traces"] == 6
+    assert [s["node"] for s in rep["stages"]] == \
+        ["tr_00_src", "tr_01_mid", "tr_02_snk"]
+    assert rep["critical_stage"]
+    assert "dispatch" in rep["launch_phases"]
+    text = wf_trace.render(rep)
+    assert "tr_01_mid" in text and "end-to-end" in text
+    # Chrome trace-event export: loads as JSON, has process/thread
+    # metadata, queue+svc slices, launch child slices, and flow arrows
+    doc = wf_trace.chrome_trace(records)
+    blob = json.dumps(doc)
+    doc2 = json.loads(blob)
+    evs = doc2["traceEvents"]
+    assert evs and doc2["displayTimeUnit"] == "ms"
+    phases = {e["ph"] for e in evs}
+    assert {"M", "X", "s", "t"} <= phases
+    for e in evs:
+        assert isinstance(e["pid"], int)
+        if e["ph"] != "M" or "tid" in e:
+            assert isinstance(e.get("tid", 1), int)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] > 0
+    names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert {"queue", "svc", "dispatch"} <= names
+    # the CLI end-to-end, into a file
+    out = str(tmp_path / "chrome.json")
+    assert wf_trace.main([str(tmp_path), "--chrome", out]) == 0
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
+    assert wf_trace.main([str(tmp_path), "--json"]) == 0
+
+
+def test_wf_trace_chrome_ctrl_instants(tmp_path):
+    """ctrl spans (checkpoint/rescale) and events.jsonl epochs render as
+    instant events."""
+    records = [
+        {"t": 100.0, "kind": "hop", "trace": 1, "span": 10,
+         "parent": None, "dataflow": "d", "node": "n0", "q_us": 0.0,
+         "svc_us": 5.0, "end_us": 5.0, "rows": 1},
+        {"t": 101.0, "kind": "ctrl", "trace": None, "span": 11,
+         "parent": None, "dataflow": "d", "node": "n0",
+         "name": "checkpoint", "epoch": 3, "dur_us": 250.0},
+    ]
+    events = [{"t": 102.0, "event": "rescale", "dataflow": "d",
+               "farm": "kf", "epoch": 4, "width_from": 1,
+               "width_to": 2, "moved_keys": 5, "ms": 1.5}]
+    wf_trace = _load_script("wf_trace")
+    for rec in records:
+        validate_span(rec)
+    doc = wf_trace.chrome_trace(records, events)
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(instants) == 2
+    assert any(e["name"] == "checkpoint e3" for e in instants)
+    assert any(e["name"] == "rescale e4" for e in instants)
+    assert all(e["s"] == "p" for e in instants)
+
+
+# ------------------------------------------------------- expo satellites
+
+def test_expo_renders_labelled_histogram_families():
+    from windflow_tpu.obs import expo
+    reg = MetricsRegistry()
+    for node in ("a", "b"):
+        h = reg.histogram(f'trace_service_seconds{{node="{node}"}}',
+                          (0.1, 1.0))
+        h.observe(0.05)
+    txt = expo.render_registry(reg)
+    # ONE family declaration, two labelled series
+    assert txt.count("# TYPE wf_trace_service_seconds histogram") == 1
+    assert 'wf_trace_service_seconds_bucket{node="a",le="0.1"} 1' in txt
+    assert 'wf_trace_service_seconds_bucket{node="b",le="+Inf"} 1' in txt
+    assert 'wf_trace_service_seconds_count{node="a"} 1' in txt
+    # flat names keep their historical rendering
+    reg2 = MetricsRegistry()
+    reg2.counter("wire_bytes_sent").inc(5)
+    assert "wf_wire_bytes_sent 5" in expo.render_registry(reg2)
+
+
+def test_expo_sample_renders_latency_gauges():
+    from windflow_tpu.obs import expo
+    sample = {"dataflow": "d", "dead_letters": 0,
+              "nodes": [{"node": "m", "id": "d_01_m", "depth": 0,
+                         "hwm": 0, "shed": 0, "quarantined": 0,
+                         "q_p95_us": 120.5, "svc_p95_us": 30.0}]}
+    txt = expo.render_sample(sample)
+    assert 'wf_node_queue_wait_p95_us{dataflow="d",node="m"} 120.5' in txt
+    assert 'wf_node_service_p95_us{dataflow="d",node="m"} 30.0' in txt
+
+
+# ----------------------------------------------------- review hardening
+
+def test_stamped_copy_detaches_batch():
+    """The recovery journal's copy_inputs defense duck-types on
+    ``.copy()``: a journaled Stamped must not alias the live batch a
+    mutating node scribbles on (replay would see transformed rows)."""
+    from time import perf_counter_ns
+    from windflow_tpu.obs.trace import SpanCtx
+    batch = np.arange(4, dtype=np.int64)
+    st = Stamped(batch, SpanCtx(1, perf_counter_ns(), None), None,
+                 perf_counter_ns())
+    dup = st.copy()
+    batch[:] = -1                       # the in-place mutation
+    np.testing.assert_array_equal(dup.batch, np.arange(4))
+    assert dup.ctx is st.ctx and dup.parent is st.parent
+
+
+def test_span_ids_stay_js_safe():
+    """Trace/span ids feed the Chrome export, read by JavaScript:
+    anything at or above 2**53 loses low bits to double rounding and
+    distinct ids silently merge in Perfetto."""
+    from windflow_tpu.obs.trace import _new_id
+    for _ in range(64):
+        assert 0 < _new_id() < 2 ** 53
+    df, _k = _run_linear(trace=TracePolicy(sample_rate=1.0))
+    for r in df.tracer.recent:
+        for field in ("trace", "span", "parent"):
+            v = r.get(field)
+            if v is not None:
+                assert 0 < v < 2 ** 53
+
+
+def test_recorder_uninstalls_with_last_tracer():
+    """Once the last live tracer closes, profile spans return to the
+    bare disabled probe — an untraced run after a traced one must not
+    keep paying the recorder tax.  (Relative to the baseline: other
+    suites may hold never-run traced graphs whose tracers stay open.)"""
+    from windflow_tpu.obs import trace as trace_mod
+    base = trace_mod._RECORDER_REFS
+    t1 = Tracer("a", TracePolicy(sample_rate=1.0))
+    t2 = Tracer("b", TracePolicy(sample_rate=1.0))
+    assert trace_mod._RECORDER_REFS == base + 2
+    assert profile._RECORDER is not None
+    t1.close()
+    t1.close()                          # idempotent: no double-decrement
+    assert trace_mod._RECORDER_REFS == base + 1
+    assert profile._RECORDER is not None
+    t2.close()
+    assert trace_mod._RECORDER_REFS == base
+    if base == 0:
+        assert profile._RECORDER is None
+
+
+def test_unclosed_tracer_releases_recorder_on_gc():
+    """A tracer that never reaches close() (preview graph, run()
+    raising before wait()) must not leak the process-wide recorder."""
+    import gc
+    from windflow_tpu.obs import trace as trace_mod
+    base = trace_mod._RECORDER_REFS
+    t = Tracer("leaky", TracePolicy(sample_rate=1.0))
+    assert trace_mod._RECORDER_REFS == base + 1
+    del t
+    gc.collect()
+    assert trace_mod._RECORDER_REFS == base
+
+
+# ---------------------------------------------------- profile satellites
+
+def test_profile_recorder_hook_fires_without_profiling():
+    seen = []
+    profile.disable()
+    try:
+        profile.set_recorder(lambda name, dt: seen.append((name, dt)))
+        with profile.span("harvest_wait"):
+            pass
+        assert seen and seen[0][0] == "harvest_wait" and seen[0][1] >= 0
+        # profiling disabled: the accumulators must stay untouched
+        assert "harvest_wait" not in profile.report()
+    finally:
+        profile.set_recorder(None)
+        profile.auto()
+
+
+def test_profile_report_snapshots_under_lock():
+    """report()/counters()/reset() while ship threads mutate the
+    accumulators: no 'dictionary changed size during iteration'."""
+    profile.enable()
+    stop = threading.Event()
+
+    def writer(i):
+        n = 0
+        while not stop.is_set():
+            profile.add(f"phase_{i}_{n % 97}", 1.0)
+            with profile.span(f"span_{i}_{n % 89}"):
+                pass
+            n += 1
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for _ in range(60):
+            profile.report()
+            profile.counters()
+        profile.reset()
+        for _ in range(30):
+            profile.report()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        profile.reset()
+        profile.auto()

@@ -87,7 +87,7 @@ class MultiPipe:
     def __init__(self, name: str = "pipe", trace_dir: str = None,
                  capacity: int = 16, overload=None, metrics=None,
                  sample_period: float = None, recovery=None,
-                 check: str = None, control=None):
+                 check: str = None, control=None, trace=None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
@@ -125,6 +125,13 @@ class MultiPipe:
         #: shedding, source admission.  None (default) keeps seed-
         #: identical behavior and never imports windflow_tpu.control.
         self.control = control
+        #: obs/trace.TracePolicy (or a sample-rate fraction) — end-to-end
+        #: span tracing (docs/OBSERVABILITY.md §tracing): sampled source
+        #: batches leave per-hop queue-wait/service spans (+ device
+        #: launch child spans) in <trace_dir>/trace.jsonl.  Falsy
+        #: (default) keeps seed-identical behavior and never imports
+        #: windflow_tpu.obs.trace.
+        self.trace = trace
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -305,7 +312,7 @@ class MultiPipe:
                       metrics=self._metrics_arg,
                       sample_period=self.sample_period,
                       recovery=self.recovery, check=self.check,
-                      control=self.control)
+                      control=self.control, trace=self.trace)
             #: the validator (check/graph.py) anchors window-geometry
             #: diagnostics at pattern construction sites via the
             #: declared stage list — only reachable through this stamp
@@ -450,6 +457,20 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                 f"cannot union MultiPipes with conflicting recovery "
                 f"policies ({recovery!r} vs {pol!r}): one Dataflow runs "
                 f"one policy — configure it on the merged pipe")
+    # one Dataflow runs one span tracer: configured trace policies must
+    # agree (or all but one be unset) — normalised lazily, so a union of
+    # untraced pipes still never imports obs.trace
+    tr_pols = [p.trace for p in pipes if p.trace]
+    trace = tr_pols[0] if tr_pols else None
+    if len(tr_pols) > 1:
+        from ..obs.trace import as_policy
+        first = as_policy(trace)
+        for pol in tr_pols[1:]:
+            if not first.agrees_with(as_policy(pol)):
+                raise ValueError(
+                    f"cannot union MultiPipes with conflicting trace "
+                    f"policies ({trace!r} vs {pol!r}): one Dataflow "
+                    f"runs one tracer — configure it on the merged pipe")
     # observability merges like capacity: the merged graph samples at the
     # finest requested cadence, and the first configured registry and
     # trace_dir win (these are additive sinks, not behavior — no conflict
@@ -468,6 +489,7 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                        overload=overload,
                        metrics=registries[0] if registries else None,
                        sample_period=min(periods) if periods else None,
-                       recovery=recovery, check=check, control=control)
+                       recovery=recovery, check=check, control=control,
+                       trace=trace)
     merged._branches = list(pipes)
     return merged

@@ -103,11 +103,14 @@ def expected(chunks) -> tuple[int, int]:
 
 
 def build_pipe(chunks, pardegree, flush_rows, depth, capacity,
-               max_delay_ms=None, rate=None):
+               max_delay_ms=None, rate=None, trace=None, trace_dir=None):
     """Assemble the pipe_test_tpu MultiPipe without running it; returns
     ``(pipe, state)`` where ``state`` is the sink's result-accumulator
     dict — shared by the timed ``run_once`` and the static analyzer
-    (scripts/wf_lint.py)."""
+    (scripts/wf_lint.py).  ``trace`` (a sample-rate fraction or
+    obs.trace.TracePolicy) + ``trace_dir`` opt the run into end-to-end
+    span tracing: <trace_dir>/trace.jsonl feeds scripts/wf_trace.py
+    (docs/OBSERVABILITY.md §tracing)."""
     state = {"rcv": 0, "total": 0, "lat_us": []}
 
     def gen(shipper):
@@ -142,7 +145,8 @@ def build_pipe(chunks, pardegree, flush_rows, depth, capacity,
     # values after Map stay in [1, 3*VAL_HI]: declare it so the resident
     # path runs warning-clean with a provably safe int32 accumulate
     red = Reducer("sum", value_range=(0, 3 * VAL_HI + 1))
-    pipe = (MultiPipe("pipe_test_tpu", capacity=capacity)
+    pipe = (MultiPipe("pipe_test_tpu", capacity=capacity,
+                      trace=trace, trace_dir=trace_dir)
             .add_source(Source(gen, SCHEMA, name="src", fresh=True))
             # Map before Filter: the predicate reads the mapped column, so
             # this order computes transform() once per batch (both stages
@@ -167,10 +171,10 @@ def wf_check_pipelines():
 
 
 def run_once(chunks, pardegree, flush_rows, depth, capacity,
-             max_delay_ms=None, rate=None):
+             max_delay_ms=None, rate=None, trace=None, trace_dir=None):
     pipe, state = build_pipe(chunks, pardegree, flush_rows, depth,
                              capacity, max_delay_ms=max_delay_ms,
-                             rate=rate)
+                             rate=rate, trace=trace, trace_dir=trace_dir)
     resident.stats_snapshot(reset=True)
     t0 = time.perf_counter()
     pipe.run_and_wait_end()
@@ -193,7 +197,7 @@ def _lat_stats(state):
 
 def run(n_tuples=8_000_000, pardegree=2, chunk=1 << 20,
         flush_rows=1 << 19, depth=48, capacity=4, runs=3,
-        max_delay_ms=None, rate=None):
+        max_delay_ms=None, rate=None, trace=None, trace_dir=None):
     """Throughput mode (max_delay_ms=None) tunes for tuples/sec; the
     LATENCY-BUDGET mode (max_delay_ms=B with a sub-capacity ``rate``)
     bounds window close-to-delivery delay via the cores' force-flush
@@ -219,7 +223,8 @@ def run(n_tuples=8_000_000, pardegree=2, chunk=1 << 20,
     all_runs = []
     for _ in range(runs):
         dt, state, diag = run_once(chunks, pardegree, flush_rows, depth,
-                                   capacity, max_delay_ms, rate)
+                                   capacity, max_delay_ms, rate,
+                                   trace=trace, trace_dir=trace_dir)
         if state["total"] != want_total or state["rcv"] != want_windows:
             raise AssertionError(
                 f"pipe_test_tpu mismatch: sum {state['total']} != "
@@ -270,9 +275,17 @@ def main(argv=None):
     ap.add_argument("--rate", type=float, default=None,
                     help="paced source, tuples/sec (latency-budget mode "
                          "needs a sub-capacity pace; default full speed)")
+    ap.add_argument("--trace", type=float, default=None,
+                    help="span-trace a sampled fraction of batches "
+                         "(0..1]; spans land in <trace-dir>/trace.jsonl "
+                         "for scripts/wf_trace.py / Perfetto")
+    ap.add_argument("--trace-dir", default=None,
+                    help="span/telemetry output directory (defaults to "
+                         "WF_LOG_DIR)")
     a = ap.parse_args(argv)
     out = run(a.tuples, a.pardegree, a.chunk, a.flush_rows, a.depth,
-              a.capacity, a.runs, a.max_delay_ms, a.rate)
+              a.capacity, a.runs, a.max_delay_ms, a.rate,
+              trace=a.trace, trace_dir=a.trace_dir)
     print(json.dumps(out))
     return 0
 

@@ -157,6 +157,11 @@ def check_pipe_config(pipe) -> list[Diagnostic]:
     if (_obs_configured(pipe._metrics_arg, pipe.sample_period)
             and not (pipe.trace_dir or default_trace_dir())):
         diags.append(_no_trace_dir_diag(pipe.name))
+    # trace= is truthiness-gated exactly like metrics= (falsy = OFF), and
+    # judged on the pipe's own merged knobs for the same union reason
+    if (getattr(pipe, "trace", None)
+            and not (pipe.trace_dir or default_trace_dir())):
+        diags.append(_ring_only_trace_diag(pipe.name))
     return diags
 
 
@@ -178,6 +183,16 @@ def _no_trace_dir_diag(name: str) -> Diagnostic:
         f"trace_dir to keep the telemetry")
 
 
+def _ring_only_trace_diag(name: str) -> Diagnostic:
+    return Diagnostic(
+        "WF213",
+        f"{name!r} runs with trace= but no resolvable trace_dir "
+        f"(trace_dir= or WF_LOG_DIR): sampled spans stay in the bounded "
+        f"in-memory ring — trace.jsonl is never written, so wf_trace / "
+        f"Perfetto export has nothing to read; set trace_dir to keep "
+        f"the spans (docs/OBSERVABILITY.md §tracing)")
+
+
 def check_dataflow_config(df) -> list[Diagnostic]:
     """Knob checks on a built Dataflow (the WF208/WF210/WF211 conflicts
     cannot exist here — constructor and wiring refuse them)."""
@@ -185,6 +200,8 @@ def check_dataflow_config(df) -> list[Diagnostic]:
     if (_obs_configured(df.metrics, df.sample_period)
             and not df.trace_dir):
         diags.append(_no_trace_dir_diag(df.name))
+    if getattr(df, "trace", None) and not df.trace_dir:
+        diags.append(_ring_only_trace_diag(df.name))
     if df.control is not None and df.metrics is None:
         diags.append(_blind_control_diag(f"Dataflow {df.name!r}"))
     return diags

@@ -75,6 +75,10 @@ CATALOG: dict[str, tuple[str, str]] = {
     "WF212": (ERROR,
               "Rescale rule targets a pattern name not wired into the "
               "graph: the controller refuses to attach at run()"),
+    "WF213": (WARNING,
+              "trace= with no resolvable trace_dir: sampled spans stay "
+              "in the bounded in-memory ring and trace.jsonl is never "
+              "written"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "

@@ -126,6 +126,18 @@ class Controller:
                     f"Rescale rule targets {rule.pattern!r}, but no "
                     f"key-partitioned farm of that name was wired into "
                     f"Dataflow {df.name!r}")
+            if (isinstance(rule, Rescale) and rule.up_q95_us is not None
+                    and getattr(df, "tracer", None) is None):
+                # the tail-latency signal is fed by the span tracer's
+                # per-node histograms; without trace= it reads 0 forever
+                # — the WF209 shape of silent inertness, for one signal
+                import warnings
+                warnings.warn(
+                    f"Rescale({rule.pattern!r}): up_q95_us is set but "
+                    f"the dataflow runs without trace= — the queue-wait "
+                    f"p95 signal never populates, so this trigger is "
+                    f"inert (docs/OBSERVABILITY.md §tracing)",
+                    stacklevel=2)
             elif isinstance(rule, AdaptiveShed):
                 pol = df.overload
                 if pol is None or pol.shed == "block":
@@ -202,7 +214,13 @@ class Controller:
             depth = max((nodes[i]["depth"] for i in ids[:fc.width]
                          if i in nodes), default=0)
             shed_rate = self._shed_rate(em_id, nodes, rec.get("t", now))
-            d = fc.rule.observe((depth, shed_rate), now)
+            # tail-latency signal (obs/trace.py): max sampled queue-wait
+            # p95 across the active workers — 0.0 (inert) until the span
+            # tracer populates the field
+            q95_us = max((nodes[i].get("q_p95_us", 0.0)
+                          for i in ids[:fc.width] if i in nodes),
+                         default=0.0)
+            d = fc.rule.observe((depth, shed_rate, q95_us), now)
             if d:
                 rule = fc.rule
                 width = fc.width
@@ -212,7 +230,8 @@ class Controller:
                 if target != width and fc.request(target):
                     self._note("rescale_request", fc.pattern.name,
                                target, depth=depth,
-                               shed_rate=round(shed_rate, 3))
+                               shed_rate=round(shed_rate, 3),
+                               q95_us=q95_us)
         if self.shed_rule is not None:
             self._drive_shed(self._max_depth(nodes), now)
         for adm in self.admissions:

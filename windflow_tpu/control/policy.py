@@ -106,10 +106,17 @@ class Rescale(_ThresholdRule):
     next epoch barrier when the rule fires.
 
     Signals (per sample): the **max inbox depth across active workers**
-    against ``up_depth``/``down_depth``, and the farm head's **shed
-    rate** (items/s since the previous sample) against ``up_shed`` —
-    sustained shedding at the emitter means the whole farm is saturated
-    regardless of how the backlog distributes.
+    against ``up_depth``/``down_depth``, the farm head's **shed rate**
+    (items/s since the previous sample) against ``up_shed`` — sustained
+    shedding at the emitter means the whole farm is saturated regardless
+    of how the backlog distributes — and the **max sampled queue-wait
+    p95 across active workers** (µs, the ``q_p95_us`` field the span
+    tracer feeds into every sampler record, docs/OBSERVABILITY.md
+    §tracing) against ``up_q95_us``: the tail-latency trigger — a farm
+    can hold a shallow average depth yet still bind a latency SLO, and
+    depth thresholds cannot see that.  ``up_q95_us`` needs the dataflow
+    to run ``trace=`` (the controller warns once and the signal stays 0
+    otherwise).
 
     Requires ``recovery=`` on the dataflow (epoch barriers are the
     consistent cut the migration seals at — the Dataflow constructor
@@ -120,8 +127,8 @@ class Rescale(_ThresholdRule):
 
     def __init__(self, pattern: str, max_workers: int,
                  min_workers: int = 1, up_depth=None, down_depth=None,
-                 up_shed=None, step: int = 1, hysteresis: int = 2,
-                 cooldown: float = 5.0):
+                 up_shed=None, up_q95_us=None, step: int = 1,
+                 hysteresis: int = 2, cooldown: float = 5.0):
         super().__init__(up_depth, down_depth, hysteresis, cooldown)
         if not pattern:
             raise ValueError("Rescale needs the target pattern's name")
@@ -136,18 +143,27 @@ class Rescale(_ThresholdRule):
             raise ValueError("step must be >= 1 worker")
         if up_shed is not None and float(up_shed) <= 0:
             raise ValueError("up_shed must be a positive items/s rate")
+        if up_q95_us is not None and float(up_q95_us) <= 0:
+            raise ValueError("up_q95_us must be a positive queue-wait "
+                             "p95 in microseconds")
         self.pattern = str(pattern)
         self.min_workers = int(min_workers)
         self.max_workers = int(max_workers)
         self.up_shed = None if up_shed is None else float(up_shed)
+        self.up_q95_us = None if up_q95_us is None else float(up_q95_us)
         self.step = int(step)
 
-    # the rescale signal is (max worker depth, head shed rate)
+    # the rescale signal is (max worker depth, head shed rate[, max
+    # worker queue-wait p95 µs]); the 2-tuple form stays accepted so
+    # pre-trace callers of the pure observe() path are unchanged
     def _classify(self, value) -> int:
-        depth, shed_rate = value
+        depth, shed_rate, *rest = value
+        q95_us = rest[0] if rest else 0.0
         if self.high is not None and depth >= self.high:
             return 1
         if self.up_shed is not None and shed_rate >= self.up_shed:
+            return 1
+        if self.up_q95_us is not None and q95_us >= self.up_q95_us:
             return 1
         if self.low is not None and depth <= self.low:
             return -1
@@ -156,13 +172,14 @@ class Rescale(_ThresholdRule):
     def _key(self):
         return ("rescale", self.pattern, self.min_workers,
                 self.max_workers, self.high, self.low, self.up_shed,
-                self.step, self.hysteresis, self.cooldown)
+                self.up_q95_us, self.step, self.hysteresis,
+                self.cooldown)
 
     def __repr__(self):
         return (f"Rescale({self.pattern!r}, {self.min_workers}.."
                 f"{self.max_workers}, up_depth={self.high}, "
                 f"down_depth={self.low}, up_shed={self.up_shed}, "
-                f"step={self.step})")
+                f"up_q95_us={self.up_q95_us}, step={self.step})")
 
 
 class AdaptiveShed(_ThresholdRule):

@@ -314,3 +314,10 @@ class FarmController:
             m.counter("ctl_rescale_up" if new > old
                       else "ctl_rescale_down").inc()
             m.gauge(f"ctl_width_{self.pattern.name}").set(new)
+        tracer = getattr(df, "tracer", None)
+        if tracer is not None:
+            # control-plane span (obs/trace.py): the migration window on
+            # the Perfetto timeline, next to the batches it stalled
+            tracer.record_ctrl(self.pattern.name, "rescale", epoch,
+                               ms / 1e3, width_from=old, width_to=new,
+                               moved_keys=self._moved)

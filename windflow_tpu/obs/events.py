@@ -42,6 +42,9 @@ EVENT_KINDS = frozenset({
     # tighten/relax, admission rate move), one `rescale` event per
     # completed epoch-barrier migration
     "control", "rescale",
+    # span tracing (obs/trace.py): spans discarded past the trace.jsonl
+    # max_spans bound — rate-limited, carries the running drop total
+    "trace_drop",
 })
 
 

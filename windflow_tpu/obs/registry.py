@@ -27,6 +27,13 @@ from bisect import bisect_left
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: bucket bounds for the span tracer's queue-wait/service histograms
+#: (obs/trace.py): finer at the bottom — inbox hops are routinely tens
+#: of microseconds, and a p95 read off DEFAULT_BUCKETS would round every
+#: healthy hop up to 0.5 ms
+LATENCY_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                   0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
 
 class Counter:
     """Monotonically increasing count (events, bytes, frames)."""
@@ -112,6 +119,36 @@ class Histogram:
     @property
     def count(self):
         return self._count
+
+    def quantile(self, q: float):
+        """Estimate the q-quantile (0..1) — see
+        :func:`quantile_from_snapshot`; None on an empty histogram."""
+        return quantile_from_snapshot(self.snapshot(), q)
+
+
+def quantile_from_snapshot(h: dict, q: float):
+    """Estimate the q-quantile (0..1) from a Histogram ``snapshot()``
+    dict ({"buckets": {bound: cumulative}, "count": n}) by linear
+    interpolation inside the containing bucket — the standard Prometheus
+    ``histogram_quantile`` estimate, shared by the sampler's per-node
+    latency fields, wf_top's columns, and wf_trace.  Returns None on an
+    empty histogram; a quantile landing in the implicit +Inf bucket
+    clamps to the top finite bound (the honest answer a bounded
+    histogram can give)."""
+    total = h.get("count", 0)
+    if not total:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in h["buckets"].items():
+        b = float(bound)
+        if cum >= rank:
+            if cum == prev_cum:
+                return b
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (b - prev_bound)
+        prev_bound, prev_cum = b, cum
+    return prev_bound  # +Inf bucket: clamp to the top finite bound
 
 
 class MetricsRegistry:

@@ -94,9 +94,10 @@ class Sampler:
 
     def _node_entry(self, idx: int, node) -> dict:
         inbox = self.df._inboxes.get(id(node))
+        node_id = node_stats_name(self.df.name, idx, node.name)
         entry = {
             "node": node.name,
-            "id": node_stats_name(self.df.name, idx, node.name),
+            "id": node_id,
             "depth": int(inbox.depth()) if inbox is not None else 0,
             "hwm": int(getattr(inbox, "hwm", 0)),
             "shed": int(getattr(inbox, "shed", 0)),
@@ -109,6 +110,17 @@ class Sampler:
             entry["rcv_tuples"] = stats.rcv_tuples
             entry["ewma_service_us_per_batch"] = round(stats.ewma_ts_us, 3)
             entry["avg_service_us_per_batch"] = round(stats.avg_ts_us, 3)
+        tracer = getattr(self.df, "tracer", None)
+        if tracer is not None:
+            # span-tracing latency sensors (obs/trace.py): per-node
+            # queue-wait/service p50/p95/p99 (µs) read off the tracer's
+            # fixed-bucket histograms — the fields ControlPolicy rules
+            # threshold on (Rescale(up_q95_us=), docs/CONTROL.md).
+            # Absent until the node saw a traced batch, so consumers of
+            # pre-trace metrics.jsonl lines see no new keys.
+            lat = tracer.latency_snapshot(node_id)
+            if lat:
+                entry.update(lat)
         return entry
 
     def sample(self) -> dict:

@@ -66,11 +66,33 @@ import numpy as np
 
 _LEN = struct.Struct("<q")
 
-#: control-frame codes carried in the length slot (negative = no payload)
+#: control-frame codes carried in the length slot (negative = no
+#: payload, except EPOCH — an 8-byte epoch follows — and TRACE — one
+#: ordinary length-prefixed JSON payload describing the NEXT data
+#: frame's span follows)
 _EOS_FRAME = -1        # clean end-of-stream (original protocol)
 _HEARTBEAT_FRAME = -2  # liveness beacon; carries no data
 _ABORT_FRAME = -3      # sender died mid-stream: NOT a clean EOS
 _EPOCH_FRAME = -4      # epoch barrier marker; 8-byte epoch payload follows
+_TRACE_FRAME = -5      # span context for the next data frame (opt-in)
+
+
+class TracedRows(np.ndarray):
+    """A received batch carrying its sender's span context: a plain
+    ndarray view with one extra attribute, ``wf_trace`` (the dict the
+    sender passed to ``send(..., trace=...)``, typically
+    ``obs.trace.export()``), so consumers that don't care handle it
+    exactly like any other batch — and a traced source node's emit
+    *adopts* it automatically (obs/trace.py), stitching the remote trace
+    onto the local graph.  Only produced by a
+    ``RowReceiver(decode_trace=True)``; a default receiver consumes and
+    discards trace frames (the field is *optional* on the wire)."""
+
+    wf_trace = None
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self.wf_trace = getattr(obj, "wf_trace", None)
 
 
 class ChannelError(ConnectionError):
@@ -192,7 +214,8 @@ class _WireTelemetry:
 
     __slots__ = ("events", "bytes_sent", "frames_sent", "bytes_recv",
                  "frames_recv", "connect_retries", "heartbeats_sent",
-                 "heartbeats_recv", "heartbeat_misses")
+                 "heartbeats_recv", "heartbeat_misses", "traces_sent",
+                 "traces_recv")
 
     def __init__(self, metrics, events=None):
         self.events = events
@@ -205,6 +228,8 @@ class _WireTelemetry:
         self.heartbeats_sent = c("wire_heartbeats_sent")
         self.heartbeats_recv = c("wire_heartbeats_recv")
         self.heartbeat_misses = c("wire_heartbeat_misses")
+        self.traces_sent = c("wire_traces_sent")
+        self.traces_recv = c("wire_traces_recv")
 
     def emit(self, event: str, **fields):
         if self.events is not None:
@@ -348,7 +373,14 @@ class RowSender:
 
     # -- data path ---------------------------------------------------------
 
-    def send(self, batch: np.ndarray):
+    def send(self, batch: np.ndarray, trace: dict = None):
+        """Ship one batch.  ``trace`` (optional, a small JSON-able dict
+        — typically ``obs.trace.export()``) rides ahead of the data as a
+        TRACE control frame, so a span sampled on this host survives the
+        row-plane hop (a ``decode_trace=True`` receiver reattaches it,
+        any other receiver discards it).  ``trace=None`` — the default —
+        keeps the bytes on the wire identical to the original
+        protocol."""
         if len(batch) == 0:
             return
         self._check_alive()
@@ -364,6 +396,13 @@ class RowSender:
                 raise TypeError(
                     f"row channel dtype changed mid-stream: "
                     f"{self._dtype_sent} -> {batch.dtype}")
+            if trace is not None:
+                tp = json.dumps(trace).encode("utf-8")
+                self._sock.sendall(_LEN.pack(_TRACE_FRAME)
+                                   + _LEN.pack(len(tp)) + tp)
+                if self._tm is not None:
+                    self._tm.traces_sent.inc()
+                    self._tm.bytes_sent.inc(2 * _LEN.size + len(tp))
             payload = np.ascontiguousarray(batch).tobytes()
             self._sock.sendall(_LEN.pack(len(payload)) + payload)
             self._last_send = time.monotonic()
@@ -450,8 +489,13 @@ class RowReceiver:
     def __init__(self, n_senders: int, host: str = "127.0.0.1",
                  port: int = 0, capacity: int = 64,
                  stall_timeout: float = None, accept_timeout: float = None,
-                 metrics=None, events=None):
+                 metrics=None, events=None, decode_trace: bool = False):
         self._tm = _telemetry(metrics, events)  # see RowSender
+        #: opt-in span passthrough: True re-attaches sender trace frames
+        #: to their batches as :class:`TracedRows` (``batch.wf_trace``);
+        #: False (default) consumes and discards them, so a tracing
+        #: sender is always safe to point at a non-tracing receiver
+        self.decode_trace = bool(decode_trace)
         self.n_senders = int(n_senders)
         self.stall_timeout = stall_timeout
         #: bound on the ACCEPT phase: how long to wait for all senders to
@@ -514,9 +558,13 @@ class RowReceiver:
                     self._q.put((None, None))
 
     def _next_frame(self, conn: socket.socket):
-        """One payload frame (bytes), or None on clean EOS.  Heartbeat
-        frames are consumed silently; an ABORT frame raises."""
+        """One payload frame as ``(frame, trace_or_None)`` — ``frame``
+        is bytes, an :class:`EpochMarker`, or None on clean EOS.
+        Heartbeat frames are consumed silently; a TRACE frame is held
+        and attached to the data frame that follows it (or discarded
+        when ``decode_trace`` is off); an ABORT frame raises."""
         tm = self._tm
+        trace = None
         while True:
             n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
             if n >= 0:
@@ -524,9 +572,9 @@ class RowReceiver:
                 if tm is not None:
                     tm.frames_recv.inc()
                     tm.bytes_recv.inc(_LEN.size + n)
-                return raw
+                return raw, trace
             if n == _EOS_FRAME:
-                return None
+                return None, None
             if n == _HEARTBEAT_FRAME:
                 if tm is not None:
                     tm.heartbeats_recv.inc()
@@ -537,7 +585,22 @@ class RowReceiver:
                     tm.frames_recv.inc()
                     tm.bytes_recv.inc(2 * _LEN.size)
                 from ..recovery.epoch import EpochMarker
-                return EpochMarker(epoch)
+                return EpochMarker(epoch), None
+            if n == _TRACE_FRAME:
+                tn = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                if not 0 <= tn <= (1 << 20):
+                    raise ChannelError(
+                        f"bad trace-frame payload length {tn}")
+                tp = _read_exact(conn, tn)
+                if tm is not None:
+                    tm.traces_recv.inc()
+                    tm.bytes_recv.inc(2 * _LEN.size + tn)
+                if self.decode_trace:
+                    # an undecodable trace surfaces like any bad frame
+                    # (version-mismatched peer), via _read_loop's
+                    # catch-all -> batches() raise
+                    trace = json.loads(tp.decode("utf-8"))
+                continue
             if n == _ABORT_FRAME:
                 if tm is not None:
                     tm.emit("peer_abort", role="receiver")
@@ -553,7 +616,7 @@ class RowReceiver:
             dtype = None
             got_dtype = False
             while True:
-                raw = self._next_frame(conn)
+                raw, trace = self._next_frame(conn)
                 if raw is None:
                     break
                 if type(raw) is EpochMarker:
@@ -564,7 +627,11 @@ class RowReceiver:
                     dtype = _decode_dtype(raw)
                     got_dtype = True
                     continue
-                self._q.put((idx, np.frombuffer(raw, dtype=dtype).copy()))
+                arr = np.frombuffer(raw, dtype=dtype).copy()
+                if trace is not None:
+                    arr = arr.view(TracedRows)
+                    arr.wf_trace = trace
+                self._q.put((idx, arr))
         except socket.timeout as e:
             stall = PeerStall(
                 f"row channel peer silent for {self.stall_timeout}s "
@@ -695,12 +762,14 @@ class RowReceiver:
 
 
 def partition_and_ship(batch: np.ndarray, owners: np.ndarray, my_pid: int,
-                       senders: dict) -> np.ndarray:
+                       senders: dict, trace: dict = None) -> np.ndarray:
     """Split one batch by owning process (``owners`` from
     ``multihost.process_for_keys``): rows owned here are returned for
     local processing; every other process's rows go out through its
     ``senders[pid]`` RowSender.  The one-call form of the multi-host
-    source contract for non-key-partitioned inputs."""
+    source contract for non-key-partitioned inputs.  ``trace``
+    (typically ``obs.trace.export()``) rides with every shipped part so
+    a sampled batch's span survives the hop."""
     mine = batch[owners == my_pid]
     covered = np.isin(owners, [my_pid, *senders])
     if not covered.all():
@@ -714,5 +783,5 @@ def partition_and_ship(batch: np.ndarray, owners: np.ndarray, my_pid: int,
             continue
         part = batch[owners == pid]
         if len(part):
-            snd.send(part)
+            snd.send(part, trace=trace)
     return mine

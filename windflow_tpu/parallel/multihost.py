@@ -126,7 +126,8 @@ def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
 
 
 def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
-                   wire=None, metrics=None, events=None):
+                   wire=None, metrics=None, events=None,
+                   decode_trace: bool = False):
     """Build the full cross-host row data plane for a process: one
     :class:`~windflow_tpu.parallel.channel.RowReceiver` listening at
     ``addresses[my_pid]`` and one hardened
@@ -154,7 +155,13 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     aggregate across peers, and reconnect/stall/abort events carry per
     -peer detail (docs/OBSERVABILITY.md).  Pass the owning Dataflow's
     ``.metrics`` / ``.events`` to fold the wire into its sampler
-    output; both None (default) = no telemetry, seed-identical wire."""
+    output; both None (default) = no telemetry, seed-identical wire.
+
+    ``decode_trace=True`` re-attaches inbound span-trace frames
+    (``send(..., trace=obs.trace.export())`` on the peer) to their
+    batches as ``TracedRows`` so a traced source on this host adopts
+    them and the multihost graph stitches one trace
+    (docs/OBSERVABILITY.md §tracing); the default discards them."""
     from .channel import RowReceiver, RowSender, WireConfig
     if my_pid not in addresses:
         raise KeyError(f"addresses has no entry for this process "
@@ -170,7 +177,8 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                            # surface within the boot-order budget, not
                            # hang batches() forever
                            accept_timeout=wire.connect_deadline,
-                           metrics=metrics, events=events)
+                           metrics=metrics, events=events,
+                           decode_trace=decode_trace)
     senders = {}
     try:
         for pid in sorted(addresses):

@@ -113,6 +113,27 @@ class Comb(Node):
         # stage emits through them
         self.stages[-1]._outputs = self._outputs
         self.stages[0].n_input_channels = self.n_input_channels
+        if self._tracer is not None:
+            # span sampling survives fusion (obs/trace.py): only the LAST
+            # stage crosses a real inbox, so only it wraps traced batches;
+            # a fused SOURCE makes its sampling decision at the FIRST
+            # stage's emit (the ingest anchor), which flows to the tail
+            # through the shared thread-local — inner synchronous edges
+            # need no wrapping and the middle stages stay hook-free
+            last = self.stages[-1]
+            last._tracer = self._tracer
+            # inherit the Comb's own wrap flag: a nested Comb that is
+            # itself an inner (synchronous-edge) stage must not let its
+            # tail wrap either
+            last._trace_wrap = self._trace_wrap
+            last._hop_id = self._hop_id
+            first = self.stages[0]
+            if self._trace_origin:
+                first._trace_origin = True
+                first._hop_id = self._hop_id
+                if first is not last:
+                    first._tracer = self._tracer
+                    first._trace_wrap = False
         for s in self.stages[1:]:
             s.n_input_channels = 1
         for s in self.stages:

@@ -367,7 +367,8 @@ class Dataflow:
     def __init__(self, name: str = "dataflow", capacity: int = 16,
                  trace_dir: str = None, overload: OverloadPolicy = None,
                  metrics=None, sample_period: float = None,
-                 recovery=None, check: str = None, control=None):
+                 recovery=None, check: str = None, control=None,
+                 trace=None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
@@ -477,6 +478,40 @@ class Dataflow:
         else:
             self.metrics = None
             self.events = None
+        # `trace` (obs/trace.TracePolicy, or a sample-rate fraction; any
+        # falsy value = OFF) opts the graph into end-to-end span tracing
+        # (docs/OBSERVABILITY.md §tracing): a sampled fraction of source
+        # batches carries a trace context, every traversed node records
+        # queue-wait + service spans, device launches become child spans,
+        # and <trace_dir>/trace.jsonl feeds scripts/wf_trace.py.  Unset
+        # means the obs.trace module is never imported — the same
+        # contract as check=/control=.
+        if trace:
+            from ..obs.trace import Tracer, as_policy
+            self.trace = as_policy(trace)
+            if not self.trace_dir:
+                # the WF207 shape of silent no-op (docs/CHECKS.md
+                # WF213): spans stay in the bounded in-memory ring and
+                # trace.jsonl is never written.  The live percentile
+                # sensors still work, so this is a warning, not an
+                # error — but it is almost always a missing trace_dir.
+                import warnings
+                warnings.warn(
+                    f"[WF213] Dataflow {name!r}: trace= is set but no "
+                    f"trace_dir resolves (trace_dir= or WF_LOG_DIR) — "
+                    f"sampled spans stay in the in-memory ring and "
+                    f"trace.jsonl is never written", stacklevel=2)
+            #: per-graph span tracer; file opens lazily, so a never-run
+            #: preview graph still creates nothing on disk
+            self.tracer = Tracer(self.name, self.trace,
+                                 trace_dir=self.trace_dir,
+                                 metrics=self.metrics, events=self.events)
+            from ..obs.trace import Stamped as _StampedCls
+            self._Stamped = _StampedCls
+        else:
+            self.trace = None
+            self.tracer = None
+            self._Stamped = None
         if control is not None and self.metrics is None:
             # the controller's only sensor is the sampler (obs/sampler.py
             # subscription); with neither metrics= nor sample_period= it
@@ -566,15 +601,28 @@ class Dataflow:
 
     def _run_node(self, node: Node):
         events = self.events
+        tracer = self.tracer
+        _Stamped = self._Stamped
         try:
             node.n_input_channels = self._inboxes[id(node)].n_sources
             if self.trace_dir or self.metrics is not None \
-                    or self.sample_period is not None:
-                from ..utils.tracing import NodeStats, node_stats_name
+                    or self.sample_period is not None \
+                    or tracer is not None:
+                from ..utils.tracing import node_stats_name
                 # index disambiguates same-named nodes (two 'map.0' stages)
                 idx = self.nodes.index(node)
-                node.stats = NodeStats(node_stats_name(self.name, idx,
-                                                       node.name))
+                node._hop_id = node_stats_name(self.name, idx, node.name)
+            if self.trace_dir or self.metrics is not None \
+                    or self.sample_period is not None:
+                from ..utils.tracing import NodeStats
+                node.stats = NodeStats(node._hop_id)
+            if tracer is not None:
+                # span-sampling hooks (obs/trace.py): sources make the
+                # sampling/adoption decision at emit; every node wraps
+                # traced emissions for the inbox crossing (Comb forwards
+                # these onto its fused stages in svc_init)
+                node._tracer = tracer
+                node._trace_origin = isinstance(node, SourceNode)
             if self.metrics is not None:
                 # rich user functions may bump custom metrics through
                 # their RuntimeContext (ctx.metrics.counter(...).inc())
@@ -604,23 +652,45 @@ class Dataflow:
                     src, item = inbox.get()
                     if item is _EOS:
                         live -= 1
+                        if tracer is not None:
+                            # channel-EOS flushes (ordering drains, farm
+                            # collector merges) are not attributable to
+                            # any sampled batch: clear the previous
+                            # iteration's span before they emit
+                            tracer.set_current(None)
                         node.on_channel_eos(src)
                         if events is not None:
                             events.emit("eos", dataflow=self.name,
                                         node=node.name, channel=src,
                                         live=live)
-                    elif budget > 0:
+                        continue
+                    ctx = None
+                    if tracer is not None:
+                        # unwrap a traced batch and expose its span to
+                        # this svc call's emissions via the thread-local
+                        # (set for EVERY batch — a stale ctx must never
+                        # leak onto the next, untraced one)
+                        if type(item) is _Stamped:
+                            item, ctx, parent, span, q_ns = \
+                                tracer.incoming(item)
+                            tracer.set_current(ctx, span, node._hop_id)
+                        else:
+                            tracer.set_current(None)
+                    timed = stats is not None or ctx is not None
+                    if budget > 0:
                         # poison-tuple quarantine: an svc error within
                         # budget parks the batch in the dead-letter queue
                         # and the node lives on; once the budget is spent
                         # the next error fails fast exactly like default
                         try:
-                            if stats is None:
-                                node.svc(item, src)
-                            else:
+                            if timed:
                                 t0 = _pc_ns()
                                 node.svc(item, src)
-                                stats.record_svc(len(item), _pc_ns() - t0)
+                                dt = _pc_ns() - t0
+                                if stats is not None:
+                                    stats.record_svc(len(item), dt)
+                            else:
+                                node.svc(item, src)
                         except OverloadError:
                             # a put deadline expiring inside svc's emit is
                             # backpressure failure, not a poison tuple —
@@ -629,12 +699,23 @@ class Dataflow:
                         except Exception as e:  # _Cancelled passes through
                             budget -= 1
                             self._quarantine(node, item, src, e)
-                    elif stats is None:
-                        node.svc(item, src)
-                    else:
+                            continue    # no span: the batch died here
+                    elif timed:
                         t0 = _pc_ns()
                         node.svc(item, src)
-                        stats.record_svc(len(item), _pc_ns() - t0)
+                        dt = _pc_ns() - t0
+                        if stats is not None:
+                            stats.record_svc(len(item), dt)
+                    else:
+                        node.svc(item, src)
+                    if ctx is not None:
+                        tracer.record_hop(ctx, node._hop_id, span, parent,
+                                          q_ns, dt, len(item))
+            if tracer is not None:
+                # EOS flushes are not attributable to any sampled batch:
+                # clear the thread-local so the last traced batch's span
+                # cannot leak onto eosnotify emissions
+                tracer.set_current(None)
             if not supervised:
                 # the supervised loop already ran eosnotify inside its
                 # restart-protected region (a flush crash restores +
@@ -706,6 +787,10 @@ class Dataflow:
                     if self._dispatch_supervised(node, rec, events, src,
                                                  item):
                         self._complete_barriers(node, rec, events)
+                if self.tracer is not None:
+                    # EOS flushes are not attributable to any sampled
+                    # batch (see the seed loop)
+                    self.tracer.set_current(None)
                 node.eosnotify()
                 return
             except (_Cancelled, OverloadError):
@@ -748,6 +833,8 @@ class Dataflow:
                 return False
             rec.live -= 1
             rec.eos.add(src)
+            if self.tracer is not None:
+                self.tracer.set_current(None)   # see the seed loop
             node.on_channel_eos(src)
             if events is not None:
                 events.emit("eos", dataflow=self.name, node=node.name,
@@ -797,6 +884,8 @@ class Dataflow:
         if item is _EOS:
             rec.live -= 1
             rec.eos.add(src)
+            if self.tracer is not None:
+                self.tracer.set_current(None)   # see the seed loop
             node.on_channel_eos(src)
             if events is not None:
                 events.emit("eos", dataflow=self.name, node=node.name,
@@ -808,16 +897,32 @@ class Dataflow:
     def _svc_supervised(self, node: Node, rec, src, payload):
         """svc + stats + poison-tuple quarantine, mirroring the seed
         loop; budget lives on the recovery record so restarts restore
-        it with the snapshot."""
+        it with the snapshot.  Traced batches (obs/trace.py Stamped —
+        the recovery envelope wraps outside it, so held-back and
+        journal-replayed items arrive here still stamped) unwrap and
+        record their hop span; a replayed hop re-records honestly, with
+        the restore time inside its queue wait."""
         stats = node.stats
+        tracer = self.tracer
+        ctx = None
+        if tracer is not None:
+            if type(payload) is self._Stamped:
+                payload, ctx, parent, span, q_ns = \
+                    tracer.incoming(payload)
+                tracer.set_current(ctx, span, node._hop_id)
+            else:
+                tracer.set_current(None)
+        timed = stats is not None or ctx is not None
         if rec.budget > 0:
             try:
-                if stats is None:
-                    node.svc(payload, src)
-                else:
+                if timed:
                     t0 = _pc_ns()
                     node.svc(payload, src)
-                    stats.record_svc(len(payload), _pc_ns() - t0)
+                    dt = _pc_ns() - t0
+                    if stats is not None:
+                        stats.record_svc(len(payload), dt)
+                else:
+                    node.svc(payload, src)
             except OverloadError:
                 raise
             except Exception as e:
@@ -831,12 +936,18 @@ class Dataflow:
                 else:
                     rec.quarantined += 1
                     self._quarantine(node, payload, src, e)
-        elif stats is None:
-            node.svc(payload, src)
-        else:
+                return      # no span: the batch died here
+        elif timed:
             t0 = _pc_ns()
             node.svc(payload, src)
-            stats.record_svc(len(payload), _pc_ns() - t0)
+            dt = _pc_ns() - t0
+            if stats is not None:
+                stats.record_svc(len(payload), dt)
+        else:
+            node.svc(payload, src)
+        if ctx is not None:
+            tracer.record_hop(ctx, node._hop_id, span, parent, q_ns, dt,
+                              len(payload))
 
     def _complete_barriers(self, node: Node, rec, events):
         while True:
@@ -887,6 +998,12 @@ class Dataflow:
         work (its results pre-date the barrier), snapshot state, commit
         in-memory, and hand the blob to the supervisor's writer."""
         t0 = _monotonic()
+        if self.tracer is not None:
+            # barrier drains are not attributable to any sampled batch
+            # (the EOS-flush rule): without this clear, the LAST
+            # processed batch's span would leak onto every
+            # checkpoint_prepare emission below
+            self.tracer.set_current(None)
         for out in (node.checkpoint_prepare() or ()):
             if out is not None and len(out):
                 node.emit(out)
@@ -918,6 +1035,12 @@ class Dataflow:
         self._supervisor.note_checkpoint(node, rec, epoch,
                                          _monotonic() - t0)
         self._supervisor.enqueue_blob(rec, epoch, state)
+        if self.tracer is not None:
+            # control-plane span (obs/trace.py): the barrier stall this
+            # node's traced batches sat behind, on the Perfetto timeline
+            self.tracer.record_ctrl(node._hop_id or node.name,
+                                    "checkpoint", epoch,
+                                    _monotonic() - t0)
 
     def _restore_and_replay(self, node: Node, rec, events):
         t0 = _monotonic()
@@ -1037,6 +1160,8 @@ class Dataflow:
                 # flush pending checkpoint blobs — briefly on the
                 # timeout path, so wait(timeout=) keeps its bound
                 self._supervisor.stop(wait_s=1.0 if timed_out else 30.0)
+            if self.tracer is not None:
+                self.tracer.close()     # flush buffered spans to disk
             if self.events is not None and not self._stop_logged:
                 self._stop_logged = True
                 self.events.emit("dataflow_stop", dataflow=self.name,

@@ -83,6 +83,21 @@ class Node:
     #: tuple, so the dataflow-wide error_budget default must NOT
     #: quarantine it (an explicit node-level error_budget still wins)
     quarantine_exempt = False
+    #: span-tracing hooks (obs/trace.py): the engine stamps ``_tracer``
+    #: on every node of a traced dataflow (``trace=``, docs/
+    #: OBSERVABILITY.md §tracing); ``_trace_origin`` marks source nodes,
+    #: whose emissions make the sampling/wire-adoption decision;
+    #: ``_trace_wrap`` is False only on fused inner stages
+    #: (runtime/comb.py), whose synchronous edges carry the span via the
+    #: thread-local instead of a Stamped wrapper; ``_hop_id`` is the
+    #: canonical node id spans are recorded under.  All default to the
+    #: disabled state, so an untraced graph pays one dead ``_tracer is
+    #: not None`` branch per emitted batch — the standard opt-in
+    #: contract.
+    _tracer = None
+    _trace_origin = False
+    _trace_wrap = True
+    _hop_id = None
     #: True on nodes whose inbox may LOAD-SHED under a shedding
     #: OverloadPolicy: farm heads (routing emitters — dropping there is
     #: dropping raw stream items, the classic shedding point) and
@@ -180,6 +195,13 @@ class Node:
             return
         if self.stats is not None:
             self.stats.record_departure()
+        tr = self._tracer
+        if tr is not None:
+            # span tracing (obs/trace.py): sources decide sampling here;
+            # traced batches cross inboxes as Stamped wrappers (the
+            # recovery envelope, below, wraps OUTSIDE — the journal
+            # replays exactly what was emitted)
+            batch = tr.outgoing(batch, self)
         if self._recov is not None:
             # recovery layer on: sequence-tag the emission per edge (and
             # let sources trail epoch markers) — recovery/epoch.py
@@ -194,6 +216,9 @@ class Node:
             return
         if self.stats is not None:
             self.stats.record_departure()
+        tr = self._tracer
+        if tr is not None:
+            batch = tr.outgoing(batch, self)
         if self._recov is not None:
             self._recov.emit_to(self._outputs, out, batch)
             return

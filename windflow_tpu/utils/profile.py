@@ -82,27 +82,51 @@ _val: dict[str, float] = defaultdict(float)
 #: read-add-store on the accumulators must not lose updates
 _mu = threading.Lock()
 
+#: per-exit observer hook (obs/trace.py): called as ``fn(name, dt_ns)``
+#: after every completed span, INDEPENDENTLY of the WF_PROFILE
+#: accumulators — the bridge that turns the ship-path phase spans
+#: (device_put / dispatch / harvest_wait, ops/resident.py) into
+#: child spans of a traced batch.  One recorder per process; None
+#: (default) keeps the probe a bare global read.
+_RECORDER = None
+
+
+def set_recorder(fn):
+    """Install the span-exit observer (``fn(name, dt_ns)``).  The
+    recorder must be cheap and must not raise — it runs inside the
+    device ship hot path.  Installing one makes every span stamp its
+    clock even with profiling disabled; pass ``None`` to uninstall."""
+    global _RECORDER
+    _RECORDER = fn
+
 
 class span:
     """``with span("device_put"): ...`` — accumulates wall time per phase."""
 
-    __slots__ = ("name", "t0")
+    __slots__ = ("name", "t0", "_acc_on")
 
     def __init__(self, name: str):
         self.name = name
 
     def __enter__(self):
-        # the span brackets ONE decision: __exit__ accumulates iff t0
-        # was stamped, so a mid-span toggle cannot read a stale t0
-        self.t0 = time.perf_counter() if _enabled() else None
+        # the span brackets ONE decision per sink: __exit__ accumulates
+        # iff _acc_on, and calls the recorder iff t0 was stamped while
+        # one was installed — a mid-span toggle cannot read a stale t0
+        self._acc_on = _enabled()
+        self.t0 = (time.perf_counter_ns()
+                   if (self._acc_on or _RECORDER is not None) else None)
         return self
 
     def __exit__(self, *exc):
         if self.t0 is not None:
-            dt = time.perf_counter() - self.t0
-            with _mu:
-                _acc[self.name] += dt
-                _cnt[self.name] += 1
+            dt_ns = time.perf_counter_ns() - self.t0
+            if self._acc_on:
+                with _mu:
+                    _acc[self.name] += dt_ns / 1e9
+                    _cnt[self.name] += 1
+            rec = _RECORDER
+            if rec is not None:
+                rec(self.name, dt_ns)
         return False
 
 
@@ -117,17 +141,26 @@ def add(name: str, value: float = 1.0):
 
 
 def report() -> dict:
-    return {k: (round(_acc[k], 4), _cnt[k]) for k in sorted(_acc)}
+    # snapshot under the lock: ship threads mutate the defaultdicts
+    # concurrently, and iterating a dict mid-resize raises "dictionary
+    # changed size during iteration"
+    with _mu:
+        acc = dict(_acc)
+        cnt = dict(_cnt)
+    return {k: (round(acc[k], 4), cnt[k]) for k in sorted(acc)}
 
 
 def counters() -> dict:
-    return {k: _val[k] for k in sorted(_val)}
+    with _mu:
+        val = dict(_val)
+    return {k: val[k] for k in sorted(val)}
 
 
 def reset():
-    _acc.clear()
-    _cnt.clear()
-    _val.clear()
+    with _mu:
+        _acc.clear()
+        _cnt.clear()
+        _val.clear()
 
 
 def dump() -> str:
