@@ -121,6 +121,12 @@ def render(cur, prev, events=(), clock=time.localtime):
         lines.append(ctl)
     counters = {k: v for k, v in cur.get("counters", {}).items()
                 if v and not k.startswith("ctl_")}
+    # wire resume telemetry (docs/ROBUSTNESS.md "Wire resume"): the
+    # journal depth is a gauge, not a counter — fold it (and any other
+    # wire_ gauges) onto the same line so one glance shows resumes,
+    # replayed frames, and how much tail is still journaled
+    counters.update({k: int(v) for k, v in cur.get("gauges", {}).items()
+                     if k.startswith("wire_") and v})
     if counters:
         lines.append("")
         lines.append("counters: " + "  ".join(

@@ -23,10 +23,13 @@ SCHEMA = Schema(value=np.int64)
 
 #: WF### ids the CLI run over this module must report
 PLANTED = ("WF102", "WF103", "WF204", "WF205", "WF207", "WF208",
-           "WF213", "WF301")
+           "WF213", "WF214", "WF301")
 
 #: module-level scan target: heartbeat at/above the stall timeout
 BAD_WIRE = WireConfig(heartbeat=5.0, stall_timeout=2.0)   # -> WF205
+
+#: module-level scan target: journal that can never trim (no acks)
+BAD_RESUME_WIRE = WireConfig(resume=True)                 # -> WF214
 
 
 def _red(key, gwid, rows):
@@ -89,4 +92,4 @@ def _race_pipe() -> MultiPipe:
 
 def wf_check_pipelines():
     return [_window_pipe(), _overload_pipe(), _recovery_pipe(),
-            _trace_pipe(), _race_pipe(), BAD_WIRE]
+            _trace_pipe(), _race_pipe(), BAD_WIRE, BAD_RESUME_WIRE]

@@ -15,7 +15,7 @@ from windflow_tpu.core.tuples import Schema, batch_from_columns
 from windflow_tpu.parallel.channel import (_LEN, ChannelError, PeerAbort,
                                            PeerStall, RowReceiver,
                                            RowSender, WireConfig,
-                                           _encode_dtype)
+                                           WireResume, _encode_dtype)
 
 SCHEMA = Schema(value=np.int64)
 
@@ -398,3 +398,384 @@ def test_wire_epoch_eos_releases_held_rows():
     got = list(recv.batches(epoch_markers=True))
     rows = sum(len(x) for x in got if isinstance(x, np.ndarray))
     assert rows == 9               # nothing held forever, nothing lost
+
+
+# ------------------------------------------------------------ wire resume
+# docs/ROBUSTNESS.md "Wire resume": sender journals + -6 handshake +
+# seq dedup make peer death on an established edge a bounded retry.
+# Everything here is opt-in; the first tests pin the opt-OUT contract.
+
+def _values(seq):
+    """All row values, in arrival order, from a batches() iteration."""
+    out = []
+    for x in seq:
+        if isinstance(x, np.ndarray):
+            out.extend(int(v) for v in x["value"])
+    return out
+
+
+def test_wire_config_validate_called_from_constructors():
+    """Satellite: a direct-constructed pair must reject an inconsistent
+    WireConfig (WF205) at the constructor, not only via open_row_plane."""
+    bad = WireConfig(heartbeat=5.0, stall_timeout=2.0)
+    with pytest.raises(ValueError, match="WF205"):
+        RowSender("127.0.0.1", 1, wire=bad)
+    with pytest.raises(ValueError, match="WF205"):
+        RowReceiver(n_senders=1, wire=bad)
+
+
+def test_resume_unset_wire_is_byte_identical_to_seed():
+    """resume= unset: the wire carries ONLY the seed grammar (dtype
+    frame, data frames, -4 epochs, -1 EOS) — no -6 frames, no journal,
+    no ack thread.  Captured off a raw socket so nothing in the channel
+    implementation can vouch for itself."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def feed():
+        s = RowSender("127.0.0.1", port)
+        s.send(mk_batch(4))
+        s.send_epoch(1)
+        s.send(mk_batch(4, lo=50))
+        s.close()
+        assert not hasattr(s, "_journal"), "journal built without resume="
+        assert s._ack_thread is None if hasattr(s, "_ack_thread") else True
+
+    t = threading.Thread(target=feed)
+    t.start()
+    conn, _ = srv.accept()
+    raw = bytearray()
+    while True:
+        chunk = conn.recv(65536)
+        if not chunk:
+            break
+        raw.extend(chunk)
+    t.join()
+    conn.close()
+    srv.close()
+    # parse the whole stream with the SEED grammar
+    lens, off = [], 0
+    while off < len(raw):
+        (n,) = _LEN.unpack(bytes(raw[off:off + 8]))
+        off += 8
+        lens.append(n)
+        if n > 0:
+            off += n
+        elif n == -4:
+            off += 8
+        else:
+            assert n == -1, f"non-seed control frame {n} on the wire"
+    assert off == len(raw)
+    # dtype frame, data, epoch, data, EOS — and nothing else
+    assert [n for n in lens if n < 0] == [-4, -1]
+    assert sum(1 for n in lens if n > 0) == 3   # dtype + 2 payloads
+
+
+def test_faults_module_never_imported_without_a_plan():
+    """The chaos harness is dead weight unless threaded in: a plan-less
+    roundtrip must not even import parallel.faults."""
+    import subprocess
+    import sys as _sys
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "from windflow_tpu.core.tuples import Schema, batch_from_columns\n"
+        "from windflow_tpu.parallel.channel import RowReceiver, RowSender\n"
+        "r = RowReceiver(n_senders=1)\n"
+        "s = RowSender('127.0.0.1', r.port)\n"
+        "ids = np.arange(4)\n"
+        "s.send(batch_from_columns(Schema(value=np.int64), key=ids*0,\n"
+        "                          id=ids, ts=ids, value=ids))\n"
+        "s.close()\n"
+        "assert sum(len(b) for b in r.batches()) == 4\n"
+        "assert 'windflow_tpu.parallel.faults' not in sys.modules\n"
+    )
+    proc = subprocess.run([_sys.executable, "-c", code],
+                          capture_output=True, timeout=120,
+                          env={**__import__('os').environ,
+                               "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+
+
+def test_resume_roundtrip_preserves_order_and_markers():
+    """A resumable edge with no faults yields exactly the seed
+    sequence: rows in order, EpochMarker at the barrier."""
+    from windflow_tpu.recovery.epoch import EpochMarker
+    rs = WireResume(deadline=10.0)
+    recv = RowReceiver(n_senders=1, resume=rs)
+    snd = RowSender("127.0.0.1", recv.port, resume=rs)
+    snd.send(mk_batch(4))
+    snd.send_epoch(1)
+    snd.send(mk_batch(4, lo=50))
+    snd.close()
+    seq = list(recv.batches(epoch_markers=True))
+    recv.close()
+    markers = [i for i, x in enumerate(seq) if isinstance(x, EpochMarker)]
+    assert len(markers) == 1 and seq[markers[0]].epoch == 1
+    assert _values(seq) == list(range(4)) + list(range(50, 54))
+
+
+def test_resume_receiver_restart_replays_tail():
+    """Kill the receiver mid-stream; a restarted receiver on the same
+    port gets the whole journaled tail replayed — nothing lost."""
+    rs = WireResume(deadline=20.0)
+    r1 = RowReceiver(n_senders=1, resume=rs)
+    port = r1.port
+    snd = RowSender("127.0.0.1", port, resume=rs, connect_deadline=10.0)
+    for i in range(8):
+        snd.send(mk_batch(1, lo=i))
+    r1.close()                      # peer death, no EOS seen
+    r2 = RowReceiver(n_senders=1, port=port, resume=rs)
+    for i in range(8, 16):
+        snd.send(mk_batch(1, lo=i))
+    snd.close()
+    vals = _values(r2.batches())
+    r2.close()
+    # r1 consumed nothing, so the fresh receiver sees the full stream
+    assert sorted(set(vals)) == list(range(16))
+    assert vals == sorted(vals), "replay broke arrival order"
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_resume_fault_plan_differential(seed):
+    """Acceptance: >= 3 distinct seeded FaultPlans, output byte-identical
+    (values, order) to the unfaulted oracle."""
+    from windflow_tpu.parallel.faults import FaultPlan
+    plan = FaultPlan.seeded(seed, horizon=28, n_faults=3,
+                            kinds=("kill", "torn", "dup"))
+    rs = WireResume(deadline=15.0)
+    recv = RowReceiver(n_senders=1, resume=rs)
+    got, errs = [], []
+
+    def consume():
+        try:
+            got.extend(_values(recv.batches(epoch_markers=True)))
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    snd = RowSender("127.0.0.1", recv.port, resume=rs, faults=plan,
+                    connect_deadline=10.0)
+    for i in range(24):
+        snd.send(mk_batch(1, lo=i))
+        if (i + 1) % 6 == 0:
+            snd.send_epoch((i + 1) // 6)
+    snd.close()
+    t.join(timeout=60)
+    assert not t.is_alive() and not errs, (plan, errs)
+    recv.close()
+    assert got == list(range(24)), (plan, got)
+
+
+def test_resume_dup_faults_dedup_by_seq():
+    """Duplicated delivery (the at-least-once replay race) must be
+    absorbed by seq dedup: exactly-once yield, exact order."""
+    from windflow_tpu.parallel.faults import FaultPlan
+    rs = WireResume(deadline=10.0)
+    recv = RowReceiver(n_senders=1, resume=rs)
+    snd = RowSender("127.0.0.1", recv.port, resume=rs,
+                    faults=FaultPlan(dup_at=(3, 6)))
+    for i in range(8):
+        snd.send(mk_batch(1, lo=i))
+    snd.close()
+    vals = _values(recv.batches())
+    recv.close()
+    assert vals == list(range(8))
+
+
+def test_kill_peer_mid_epoch_restart_matches_oracle():
+    """Acceptance: receiver killed and restarted mid-epoch with
+    resume_epoch=K — sealed-epoch output from the dead receiver plus the
+    restarted receiver's output equals the unkilled oracle, per-key
+    byte-identical."""
+    from windflow_tpu.recovery.epoch import EpochMarker
+
+    def drive(port, rs, half_sent, proceed):
+        """Epoch 1, then HALF of epoch 2, then (gated) the rest — the
+        gate keeps the sender alive across the receiver's death, so the
+        kill really lands mid-epoch."""
+        snd = RowSender("127.0.0.1", port, resume=rs,
+                        connect_deadline=10.0)
+        for i in range(4):
+            snd.send(mk_batch(1, lo=i))
+        snd.send_epoch(1)
+        for i in range(4, 8):
+            snd.send(mk_batch(1, lo=i))
+        half_sent.set()
+        assert proceed.wait(30)
+        for i in range(8, 12):
+            snd.send(mk_batch(1, lo=i))
+        snd.send_epoch(2)
+        snd.close()
+
+    def events(pre_set=False):
+        a, b = threading.Event(), threading.Event()
+        if pre_set:
+            b.set()
+        return a, b
+
+    # oracle: the same stream, nobody dies (gate pre-opened)
+    rs = WireResume(deadline=20.0)
+    r = RowReceiver(n_senders=1, resume=rs)
+    t = threading.Thread(target=drive, args=(r.port, rs, *events(True)))
+    t.start()
+    oracle = _values(r.batches())
+    t.join()
+    r.close()
+
+    # killed run: r1 consumes exactly the sealed epoch 1, then dies
+    # while epoch 2 is half on the wire
+    r1 = RowReceiver(n_senders=1, resume=rs)
+    port = r1.port
+    sealed, entered = [], threading.Event()
+
+    def consume_epoch1():
+        for x in r1.batches(epoch_markers=True):
+            if isinstance(x, EpochMarker):
+                break
+            sealed.extend(int(v) for v in x["value"])
+        entered.set()
+
+    ct = threading.Thread(target=consume_epoch1, daemon=True)
+    ct.start()
+    half_sent, proceed = events()
+    st = threading.Thread(target=drive, args=(port, rs, half_sent,
+                                              proceed))
+    st.start()
+    assert entered.wait(30), "epoch-1 barrier never completed"
+    assert half_sent.wait(30)
+    ct.join(timeout=10)
+    r1.close()                               # mid-epoch-2 death
+    r2 = RowReceiver(n_senders=1, port=port, resume=rs, resume_epoch=1)
+    proceed.set()
+    tail = _values(r2.batches())
+    st.join(timeout=30)
+    r2.close()
+    assert sealed + tail == oracle
+
+
+def test_resume_journal_trims_on_epoch_ack():
+    """ack_epochs (WireConfig recovery=): each completed barrier acks
+    back and the sender journal trims to the unsealed tail — bounded by
+    epoch width, the WF214 contract."""
+    rs = WireResume(deadline=10.0)
+    recv = RowReceiver(n_senders=1, resume=rs, ack_epochs=True)
+    done = threading.Event()
+
+    def consume():
+        for _ in recv.batches(epoch_markers=True):
+            pass
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    snd = RowSender("127.0.0.1", recv.port, resume=rs)
+    for e in range(1, 4):
+        for i in range(4):
+            snd.send(mk_batch(1, lo=e * 10 + i))
+        snd.send_epoch(e)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with snd._journal_mu:
+            depth = len(snd._journal)
+        if depth == 0:
+            break
+        time.sleep(0.05)
+    assert depth == 0, f"journal never trimmed (depth {depth})"
+    snd.close()
+    assert done.wait(10)
+    recv.close()
+
+
+def test_resume_journal_overflow_fails_loudly():
+    """A journal past its cap evicts; a resume that would need the
+    evicted prefix must raise ChannelError, never silently truncate."""
+    rs = WireResume(deadline=3.0, journal_frames=4)
+    r1 = RowReceiver(n_senders=1, resume=rs)
+    port = r1.port
+    snd = RowSender("127.0.0.1", port, resume=rs, connect_deadline=5.0)
+    for i in range(10):                 # no acks: floor moves past 0
+        snd.send(mk_batch(1, lo=i))
+    r1.close()
+    r2 = RowReceiver(n_senders=1, port=port, resume=rs)
+    with pytest.raises(ChannelError):
+        # the fresh receiver answers WELCOME{seq: 0} < journal floor
+        for i in range(10, 40):
+            snd.send(mk_batch(1, lo=i))
+            time.sleep(0.05)
+    snd.abort()
+    r2.close()
+
+
+def test_resume_counters_and_events():
+    """Resume telemetry: wire_down/wire_resume events and the
+    wire_resumes / wire_replayed_frames counters (docs/OBSERVABILITY.md)."""
+    from windflow_tpu.obs import EventLog, MetricsRegistry
+    reg, log = MetricsRegistry(), EventLog()
+    rs = WireResume(deadline=20.0)
+    r1 = RowReceiver(n_senders=1, resume=rs, metrics=reg, events=log)
+    port = r1.port
+    snd = RowSender("127.0.0.1", port, resume=rs, connect_deadline=10.0,
+                    metrics=reg, events=log)
+    for i in range(4):
+        snd.send(mk_batch(1, lo=i))
+    r1.close()
+    r2 = RowReceiver(n_senders=1, port=port, resume=rs,
+                     metrics=reg, events=log)
+    for i in range(4, 8):
+        snd.send(mk_batch(1, lo=i))
+    snd.close()
+    assert _values(r2.batches()) == list(range(8))
+    r2.close()
+    assert reg.counter("wire_resumes").value >= 1
+    assert reg.counter("wire_replayed_frames").value >= 1
+    kinds = {e["event"] for e in log.recent}
+    assert {"wire_down", "wire_resume"} <= kinds
+
+
+def test_open_row_plane_resume_knob_plumbs_through():
+    """open_row_plane(resume=...) hands the knob to both halves of the
+    plane; unset leaves the raw seed channel objects."""
+    from windflow_tpu.parallel.multihost import open_row_plane
+    p0, p1 = free_port(), free_port()
+    addrs = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    rs = WireResume(deadline=10.0)
+
+    planes = {}
+
+    def open_half(pid):
+        planes[pid] = open_row_plane(pid, addrs, resume=rs)
+
+    t = threading.Thread(target=open_half, args=(1,))
+    t.start()
+    open_half(0)
+    t.join(timeout=30)
+    recv0, senders0 = planes[0]
+    recv1, senders1 = planes[1]
+    try:
+        assert recv0._resume is rs and recv1._resume is rs
+        assert senders0[1]._resume is rs and senders1[0]._resume is rs
+        senders0[1].send(mk_batch(3))
+        senders0[1].close()
+        senders1[0].close()
+        assert sum(len(b) for b in recv1.batches()) == 3
+        assert sum(len(b) for b in recv0.batches()) == 0
+    finally:
+        for r in (recv0, recv1):
+            r.close()
+
+
+@pytest.mark.slow
+def test_soak_wire_slice():
+    """Small in-suite slice of scripts/soak_wire.py (the full soak is a
+    standalone seeded harness, docs/ROBUSTNESS.md "Wire resume")."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "soak_wire", os.path.join(os.path.dirname(__file__), os.pardir,
+                                  "scripts", "soak_wire.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for case in range(6):
+        mod.run_case(seed=7, case=case)

@@ -262,6 +262,8 @@ CORPUS = {
               lambda t: _ctl_pipe(t)),
     "WF213": (lambda t: _trace_pipe(None),
               lambda t: _trace_pipe(str(t))),
+    "WF214": (lambda t: WireConfig(resume=True),
+              lambda t: WireConfig(resume=True, recovery=True)),
     "WF301": (lambda t: _race_pipe(guarded=False),
               lambda t: _race_pipe(guarded=True)),
     "WF302": (lambda t: _global_pipe(True),

@@ -352,6 +352,138 @@ def test_row_channel_fails_fast_on_dead_peer():
     assert err is not None, "dead peer was swallowed as EOS"
 
 
+_RESUME_SENDER = r"""
+import os, sys, time
+import numpy as np
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.parallel.channel import RowSender, WireResume
+
+port, flag_path = int(sys.argv[1]), sys.argv[2]
+schema = Schema(value=np.int64)
+snd = RowSender("127.0.0.1", port, resume=WireResume(deadline=30.0),
+                connect_deadline=30.0)
+
+def ship(lo, hi):
+    for i in range(lo, hi):
+        snd.send(batch_from_columns(schema, key=[0], id=[i], ts=[i],
+                                    value=[i]))
+
+ship(0, 8)
+snd.send_epoch(1)
+ship(8, 16)
+snd.send_epoch(2)
+# hold the last epoch until the parent signals the restarted receiver is
+# up — keeps the sender alive across the peer's death
+deadline = time.time() + 60
+while not os.path.exists(flag_path):
+    assert time.time() < deadline, "restart flag never appeared"
+    time.sleep(0.05)
+ship(16, 24)
+snd.send_epoch(3)
+snd.close()
+print("SENDER_OK")
+"""
+
+_RESUME_RECV_A = r"""
+import json, os, sys
+import numpy as np
+from windflow_tpu.parallel.channel import RowReceiver, WireResume
+from windflow_tpu.recovery.epoch import EpochMarker
+
+port, out_path = int(sys.argv[1]), sys.argv[2]
+r = RowReceiver(1, port=port, resume=WireResume(deadline=30.0),
+                ack_epochs=True, accept_timeout=60.0)
+it = r.batches(epoch_markers=True)
+sealed = []
+for item in it:
+    if isinstance(item, EpochMarker):
+        break                      # epoch-1 barrier (auto-acked)
+    sealed.append(int(item["value"][0]))
+with open(out_path, "w") as f:
+    json.dump({"sealed": sealed}, f)
+    f.flush()
+    os.fsync(f.fileno())
+taken = 0
+for item in it:                    # wander into epoch 2, then die hard
+    if not isinstance(item, EpochMarker):
+        taken += 1
+        if taken >= 3:
+            break
+os._exit(1)
+"""
+
+_RESUME_RECV_B = r"""
+import json, sys
+import numpy as np
+from windflow_tpu.parallel.channel import RowReceiver, WireResume
+from windflow_tpu.recovery.epoch import EpochMarker
+
+port, out_path = int(sys.argv[1]), sys.argv[2]
+r = RowReceiver(1, port=port, resume=WireResume(deadline=30.0),
+                resume_epoch=1, ack_epochs=True, accept_timeout=60.0)
+got = []
+for item in r.batches(epoch_markers=True):
+    if not isinstance(item, EpochMarker):
+        got.append(int(item["value"][0]))
+r.close()
+with open(out_path, "w") as f:
+    json.dump({"got": got}, f)
+print("RECV_B_OK")
+"""
+
+
+def test_receiver_process_restart_resumes_wire(tmp_path):
+    """The resume handshake across REAL process boundaries (the
+    in-process twins live in tests/test_channel_faults.py): receiver A
+    acks the epoch-1 barrier and hard-exits mid-epoch-2; a fresh process
+    B re-binds the same port with resume_epoch=1; the journaling sender
+    replays epoch 2 from its journal and finishes — A saw exactly epoch
+    1, B sees exactly epochs 2..3, no gaps and no duplicates."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    scripts = {}
+    for name, src in (("sender", _RESUME_SENDER), ("recv_a", _RESUME_RECV_A),
+                      ("recv_b", _RESUME_RECV_B)):
+        p = tmp_path / f"{name}.py"
+        p.write_text(src)
+        scripts[name] = p
+    out_a, out_b = tmp_path / "out_a.json", tmp_path / "out_b.json"
+    flag = tmp_path / "restart.flag"
+
+    procs = []
+    try:
+        recv_a = subprocess.Popen(
+            [sys.executable, str(scripts["recv_a"]), str(port), str(out_a)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(recv_a)
+        sender = subprocess.Popen(
+            [sys.executable, str(scripts["sender"]), str(port), str(flag)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(sender)
+        _out, err_a = recv_a.communicate(timeout=120)
+        assert recv_a.returncode == 1, (recv_a.returncode,
+                                        err_a.decode()[-4000:])
+        recv_b = subprocess.Popen(
+            [sys.executable, str(scripts["recv_b"]), str(port), str(out_b)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(recv_b)
+        flag.touch()
+        _out, err_s = sender.communicate(timeout=120)
+        assert sender.returncode == 0, err_s.decode()[-4000:]
+        _out, err_b = recv_b.communicate(timeout=120)
+        assert recv_b.returncode == 0, err_b.decode()[-4000:]
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+
+    assert json.loads(out_a.read_text())["sealed"] == list(range(8))
+    assert json.loads(out_b.read_text())["got"] == list(range(8, 24))
+
+
 def test_partition_and_ship_rejects_uncovered_owner():
     import numpy as np
     import pytest as _pytest

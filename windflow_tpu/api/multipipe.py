@@ -137,6 +137,10 @@ class MultiPipe:
         self._has_source = False
         self._has_sink = False
         self._df: Dataflow | None = None
+        #: seal listeners registered before the deferred build; handed
+        #: to the Dataflow at _build() (and registered directly once
+        #: built) — see on_epoch_sealed
+        self._seal_listeners: list = []
 
     # ------------------------------------------------------------- builders
 
@@ -318,8 +322,23 @@ class MultiPipe:
             #: declared stage list — only reachable through this stamp
             df._check_pipe = self
             self._build_into(df)
+            for fn in self._seal_listeners:
+                df.on_epoch_sealed(fn)
             self._df = df
         return self._df
+
+    def on_epoch_sealed(self, fn) -> "MultiPipe":
+        """Register ``fn(epoch)`` to fire when the recovery supervisor
+        seals a checkpoint epoch — the sealed-ack hook for resumable
+        wire planes: ``pipe.on_epoch_sealed(receiver.ack_epoch)`` lets
+        remote RowSender journals trim at exactly the epochs this
+        pipe's checkpoints made durable (docs/ROBUSTNESS.md "Wire
+        resume").  Needs ``recovery=`` with a checkpoint_dir to ever
+        fire.  Safe before or after run()."""
+        self._seal_listeners.append(fn)
+        if self._df is not None:
+            self._df.on_epoch_sealed(fn)
+        return self
 
     # ------------------------------------------------------------------ run
 
@@ -492,4 +511,8 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                        recovery=recovery, check=check, control=control,
                        trace=trace)
     merged._branches = list(pipes)
+    # seal listeners are additive sinks like metrics registries: every
+    # operand's hooks fire on the one merged supervisor
+    for p in pipes:
+        merged._seal_listeners.extend(p._seal_listeners)
     return merged

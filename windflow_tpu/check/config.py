@@ -10,9 +10,10 @@ from .diagnostics import Diagnostic
 
 
 def check_wire(cfg) -> list[Diagnostic]:
-    """WF205/WF206 over one :class:`~windflow_tpu.parallel.channel.
-    WireConfig` (sender heartbeat vs receiver stall timeout live on the
-    same bundle, so the pairing is statically visible here)."""
+    """WF205/WF206/WF214 over one :class:`~windflow_tpu.parallel.
+    channel.WireConfig` (sender heartbeat vs receiver stall timeout —
+    and resume journal vs recovery acks — live on the same bundle, so
+    the pairings are statically visible here)."""
     diags = []
     hb, stall = cfg.heartbeat, cfg.stall_timeout
     if hb is not None and stall is not None and hb >= stall:
@@ -29,6 +30,17 @@ def check_wire(cfg) -> list[Diagnostic]:
             f"stall_timeout: beats buy nothing — a dead peer still "
             f"hangs the read forever (set stall_timeout on the paired "
             f"RowReceiver/WireConfig, docs/ROBUSTNESS.md)"))
+    if getattr(cfg, "resume", None) and not getattr(cfg, "recovery",
+                                                    False):
+        diags.append(Diagnostic(
+            "WF214",
+            f"resume= is set but recovery= is not: the receiver never "
+            f"acks sealed epochs back, so the sender journal can never "
+            f"trim — it fills to journal_frames and then evicts, "
+            f"breaking the replay guarantee for long streams (set "
+            f"recovery=True, or ack sealed epochs yourself via "
+            f"RowReceiver.ack_epoch; docs/ROBUSTNESS.md \"Wire "
+            f"resume\")"))
     return diags
 
 

@@ -79,6 +79,10 @@ CATALOG: dict[str, tuple[str, str]] = {
               "trace= with no resolvable trace_dir: sampled spans stay "
               "in the bounded in-memory ring and trace.jsonl is never "
               "written"),
+    "WF214": (WARNING,
+              "WireConfig resume= without recovery=: no sealed-epoch "
+              "acks flow back, so the sender journal can never trim and "
+              "fills to its cap"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "

@@ -30,6 +30,9 @@ EVENT_KINDS = frozenset({
     "shed", "quarantine",
     # wire (parallel/channel.py)
     "reconnect_attempt", "heartbeat_miss", "peer_stall", "peer_abort",
+    # wire resume (docs/ROBUSTNESS.md "Wire resume"): an established
+    # edge went down / was re-established with its journal tail replayed
+    "wire_down", "wire_resume",
     # recovery (windflow_tpu/recovery/, docs/ROBUSTNESS.md "Recovery")
     "epoch", "checkpoint", "checkpoint_commit", "checkpoint_skip",
     "restore", "node_restart", "recovery_giveup",

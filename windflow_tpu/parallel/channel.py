@@ -61,6 +61,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -75,6 +76,32 @@ _HEARTBEAT_FRAME = -2  # liveness beacon; carries no data
 _ABORT_FRAME = -3      # sender died mid-stream: NOT a clean EOS
 _EPOCH_FRAME = -4      # epoch barrier marker; 8-byte epoch payload follows
 _TRACE_FRAME = -5      # span context for the next data frame (opt-in)
+_RESUME_FRAME = -6     # resume protocol (docs/ROBUSTNESS.md "Wire
+#                        resume"); an 8-byte subtype follows:
+_RS_HELLO = 1          # sender->receiver on (re)connect: JSON
+#                        {token, lo, hi} — identity + journal seq range
+_RS_WELCOME = 2        # receiver->sender reply: JSON {"seq": S} (resume
+#                        after the last contiguous seq delivered) or
+#                        {"epoch": E} (a restarted receiver resuming from
+#                        its last sealed checkpoint)
+_RS_SEQ = 3            # sender->receiver: 8-byte seq tagging the NEXT
+#                        data/epoch frame (the wire Tagged envelope)
+_RS_ACK = 4            # receiver->sender: JSON {"epoch": E} | {"seq": S}
+#                        — cumulative sealed ack; the sender trims its
+#                        journal through it
+
+
+def _send_resume_frame(sock, sub: int, payload: dict):
+    js = json.dumps(payload).encode("utf-8")
+    sock.sendall(_LEN.pack(_RESUME_FRAME) + _LEN.pack(sub)
+                 + _LEN.pack(len(js)) + js)
+
+
+def _read_resume_json(sock) -> dict:
+    n = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+    if not 0 <= n <= (1 << 20):
+        raise ChannelError(f"bad resume-frame payload length {n}")
+    return json.loads(_read_exact(sock, n).decode("utf-8"))
 
 
 class TracedRows(np.ndarray):
@@ -109,22 +136,76 @@ class PeerAbort(ChannelError):
     data received so far is a truncated prefix, not a complete stream."""
 
 
+class WireResume:
+    """Knobs of the wire resume protocol (``WireConfig(resume=...)``,
+    docs/ROBUSTNESS.md "Wire resume").  ``deadline`` bounds how long a
+    broken edge may spend reconnecting before the failure turns fatal
+    (the "bounded retry" promise); ``journal_frames`` caps the sender's
+    replay journal — past it the oldest *unacked* record is evicted and
+    any resume that would need it fails loudly instead of silently
+    truncating the stream."""
+
+    __slots__ = ("deadline", "journal_frames")
+
+    def __init__(self, deadline: float = 30.0, journal_frames: int = 4096):
+        self.deadline = float(deadline)
+        self.journal_frames = int(journal_frames)
+
+    def validate(self) -> "WireResume":
+        if self.deadline <= 0:
+            raise ValueError("WireResume: deadline must be positive "
+                             "seconds (the bounded-retry window)")
+        if self.journal_frames < 1:
+            raise ValueError("WireResume: journal_frames must retain at "
+                             "least 1 record")
+        return self
+
+
+def _as_resume(value):
+    """Normalise the ``resume=`` knob: None/False = off, True = default
+    :class:`WireResume`, an instance passes through."""
+    if value is None or value is False:
+        return None
+    if value is True:
+        return WireResume()
+    if isinstance(value, WireResume):
+        return value
+    raise TypeError(f"resume= must be True/False/None or a WireResume, "
+                    f"got {value!r}")
+
+
 class WireConfig:
     """Bundle of the wire-hardening knobs, for APIs that build several
     channels at once (``multihost.open_row_plane``).  Defaults match the
     un-hardened seed protocol; ``WireConfig.hardened()`` gives the
-    recommended production settings (docs/ROBUSTNESS.md)."""
+    recommended production settings (docs/ROBUSTNESS.md).
+
+    ``resume`` (True or a :class:`WireResume`) opts the edge into the
+    resume protocol: the sender journals every record and a broken
+    connection becomes a bounded reconnect-handshake-replay cycle
+    instead of a fatal error.  ``recovery=True`` declares that the
+    deployment acks sealed epochs back to the senders
+    (``RowReceiver.ack_epoch`` — wired automatically by
+    ``batches(epoch_markers=True)`` barriers when set), which is what
+    bounds the journal by epoch width; ``resume`` without it is
+    statically flagged as WF214.  ``faults`` (a
+    ``parallel.faults.FaultPlan``) injects deterministic wire chaos on
+    the senders — a test/soak knob, never imported unless set."""
 
     __slots__ = ("connect_timeout", "connect_deadline", "heartbeat",
-                 "stall_timeout")
+                 "stall_timeout", "resume", "recovery", "faults")
 
     def __init__(self, connect_timeout: float = 30.0,
                  connect_deadline: float = None, heartbeat: float = None,
-                 stall_timeout: float = None):
+                 stall_timeout: float = None, resume=None,
+                 recovery: bool = False, faults=None):
         self.connect_timeout = connect_timeout
         self.connect_deadline = connect_deadline
         self.heartbeat = heartbeat
         self.stall_timeout = stall_timeout
+        self.resume = resume
+        self.recovery = bool(recovery)
+        self.faults = faults
 
     @classmethod
     def hardened(cls) -> "WireConfig":
@@ -138,8 +219,8 @@ class WireConfig:
         every healthy-but-idle link stall out — the receiver gives up
         before the next beat can arrive.  Size ``stall_timeout`` to
         several heartbeat intervals (``hardened()`` uses 2 s / 10 s).
-        Called by ``open_row_plane`` on every plane; returns self so it
-        chains."""
+        Called by ``open_row_plane`` AND by the ``RowSender``/
+        ``RowReceiver`` constructors; returns self so it chains."""
         if (self.heartbeat is not None and self.stall_timeout is not None
                 and self.heartbeat >= self.stall_timeout):
             raise ValueError(
@@ -147,6 +228,13 @@ class WireConfig:
                 f"be < stall_timeout ({self.stall_timeout}s) — the "
                 f"receiver would declare PeerStall before a healthy "
                 f"peer's next beat arrives")
+        rs = _as_resume(self.resume)
+        if rs is not None:
+            rs.validate()
+        if self.faults is not None and not callable(
+                getattr(self.faults, "action_for", None)):
+            raise TypeError("WireConfig: faults= must provide "
+                            "action_for(n) (parallel.faults.FaultPlan)")
         return self
 
 
@@ -215,7 +303,8 @@ class _WireTelemetry:
     __slots__ = ("events", "bytes_sent", "frames_sent", "bytes_recv",
                  "frames_recv", "connect_retries", "heartbeats_sent",
                  "heartbeats_recv", "heartbeat_misses", "traces_sent",
-                 "traces_recv")
+                 "traces_recv", "resumes", "replayed_frames", "acks_sent",
+                 "acks_recv", "journal_depth")
 
     def __init__(self, metrics, events=None):
         self.events = events
@@ -230,6 +319,12 @@ class _WireTelemetry:
         self.heartbeat_misses = c("wire_heartbeat_misses")
         self.traces_sent = c("wire_traces_sent")
         self.traces_recv = c("wire_traces_recv")
+        # resume protocol (docs/ROBUSTNESS.md "Wire resume")
+        self.resumes = c("wire_resumes")
+        self.replayed_frames = c("wire_replayed_frames")
+        self.acks_sent = c("wire_acks_sent")
+        self.acks_recv = c("wire_acks_recv")
+        self.journal_depth = metrics.gauge("wire_journal_depth")
 
     def emit(self, event: str, **fields):
         if self.events is not None:
@@ -295,14 +390,43 @@ class RowSender:
     ``connect_deadline`` (seconds) opts into connect retry with backoff;
     ``heartbeat`` (seconds) opts into idle-link liveness frames.  Both
     default to off = the original single-attempt, silent-link protocol.
+
+    ``resume`` (True / :class:`WireResume`) opts into the resume
+    protocol (docs/ROBUSTNESS.md "Wire resume"): every data/epoch
+    record is journaled with a monotone seq and tagged on the wire; a
+    link failure on an established edge becomes a bounded
+    reconnect-handshake-replay cycle (reusing the connect backoff
+    machinery) instead of a fatal error, and sealed-epoch ACK frames
+    from the receiver trim the journal.  ``faults`` (a
+    ``parallel.faults.FaultPlan``) injects deterministic chaos into the
+    transmit path — a test knob.  ``wire`` (a :class:`WireConfig`)
+    supplies any knob not given explicitly and is validated here, so a
+    direct-constructed sender can no longer carry an inconsistent
+    bundle unchecked.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  connect_deadline: float = None, heartbeat: float = None,
-                 metrics=None, events=None):
+                 metrics=None, events=None, resume=None, faults=None,
+                 wire: WireConfig = None):
+        if wire is not None:
+            wire.validate()
+            timeout = wire.connect_timeout
+            if connect_deadline is None:
+                connect_deadline = wire.connect_deadline
+            if heartbeat is None:
+                heartbeat = wire.heartbeat
+            if resume is None:
+                resume = wire.resume
+            if faults is None:
+                faults = wire.faults
         #: wire telemetry (obs registry counters + event log); None —
         #: the default — keeps every data-path hook to a single branch
         self._tm = _telemetry(metrics, events)
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._resume = _as_resume(resume)
+        self._faults = faults
         if connect_deadline is None:
             self._sock = socket.create_connection((host, port),
                                                   timeout=timeout)
@@ -320,14 +444,40 @@ class RowSender:
         self._hb_error = None
         self._hb_stop = None
         self._hb_thread = None
-        if heartbeat is not None:
-            self._hb_stop = threading.Event()
-            self._hb_thread = threading.Thread(
-                target=self._hb_loop, args=(float(heartbeat),),
-                daemon=True, name="wf-rowsend-hb")
-            self._hb_thread.start()
+        self._hb_interval = None if heartbeat is None else float(heartbeat)
+        if self._resume is not None:
+            #: the resume journal: (seq, kind, a, b) where kind "d" is a
+            #: data record (a = trace bytes or None, b = payload bytes)
+            #: and kind "e" an epoch record (a = epoch int).  Guarded by
+            #: _journal_mu — the ACK reader thread trims concurrently.
+            self._journal = deque()
+            self._journal_mu = threading.Lock()
+            self._next_seq = 1
+            #: resume is impossible at or below this seq: records there
+            #: were trimmed (acked — the receiver vouches for them) or
+            #: evicted (journal_frames cap — loud failure if needed)
+            self._floor = 0
+            self._trimmed_epoch = None
+            self._dtype = None
+            self._fault_n = 0
+            self._link_down = False
+            self._closing = False
+            self._token = "%016x" % random.getrandbits(64)
+            self._ack_thread = None
+            s = self._rs_handshake()
+            self._rs_replay(s)      # no-op on a fresh journal
+            self._start_ack_thread()
+        if self._hb_interval is not None:
+            self._start_heartbeat()
 
     # -- liveness ----------------------------------------------------------
+
+    def _start_heartbeat(self):
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, args=(self._hb_interval,),
+            daemon=True, name="wf-rowsend-hb")
+        self._hb_thread.start()
 
     def _hb_loop(self, interval: float):
         while not self._hb_stop.wait(interval):
@@ -337,15 +487,20 @@ class RowSender:
                 # now instead of at the next (possibly far-away) send.
                 # (A plain recv would honor the socket timeout and block
                 # the beat; select(0) keeps the probe non-blocking.)
-                try:
-                    readable, _, _ = select.select([self._sock], [], [], 0)
-                except ValueError:
-                    # fd beyond select's FD_SETSIZE (huge-process case):
-                    # skip the probe, the beat itself must still go out
-                    readable = []
-                if readable and self._sock.recv(4096) == b"":
-                    raise ConnectionError(
-                        "row channel peer closed the connection")
+                # With resume on, the ACK reader thread owns recv — the
+                # probe would swallow ACK bytes, so death is its job.
+                if self._resume is None:
+                    try:
+                        readable, _, _ = select.select([self._sock], [],
+                                                       [], 0)
+                    except ValueError:
+                        # fd beyond select's FD_SETSIZE (huge-process
+                        # case): skip the probe, the beat itself must
+                        # still go out
+                        readable = []
+                    if readable and self._sock.recv(4096) == b"":
+                        raise ConnectionError(
+                            "row channel peer closed the connection")
                 with self._send_lock:
                     if time.monotonic() - self._last_send >= interval:
                         self._sock.sendall(_LEN.pack(_HEARTBEAT_FRAME))
@@ -371,6 +526,281 @@ class RowSender:
             self._hb_stop.set()
             self._hb_thread.join(timeout=5.0)
 
+    # -- resume protocol (docs/ROBUSTNESS.md "Wire resume") ----------------
+
+    def _rs_handshake(self) -> int:
+        """HELLO/WELCOME on a fresh connection; returns the receiver's
+        resume point S (journal records with seq > S get replayed).
+        Raises :class:`ChannelError` when the journal can no longer
+        cover the requested tail — a resume that would silently
+        truncate the stream must fail loudly instead."""
+        with self._journal_mu:
+            lo = self._journal[0][0] if self._journal else self._next_seq
+            hi = self._next_seq - 1
+        _send_resume_frame(self._sock, _RS_HELLO,
+                           {"token": self._token, "lo": lo, "hi": hi})
+        n = _LEN.unpack(_read_exact(self._sock, _LEN.size))[0]
+        sub = (_LEN.unpack(_read_exact(self._sock, _LEN.size))[0]
+               if n == _RESUME_FRAME else None)
+        if sub != _RS_WELCOME:
+            raise ChannelError(
+                f"resume handshake: expected WELCOME, peer sent frame "
+                f"{n}/{sub} (is the receiver's resume= on?)")
+        w = _read_resume_json(self._sock)
+        with self._journal_mu:
+            if "seq" in w:
+                s = int(w["seq"])
+            else:
+                s = self._rs_seq_of_epoch(int(w["epoch"]))
+            if s < self._floor:
+                raise ChannelError(
+                    f"[resume] receiver needs records from seq {s + 1}, "
+                    f"but this journal no longer holds anything at or "
+                    f"below seq {self._floor} (acked-and-trimmed or "
+                    f"evicted past journal_frames="
+                    f"{self._resume.journal_frames}) — replay would "
+                    f"silently truncate the stream, failing loudly "
+                    f"instead")
+        return s
+
+    def _rs_seq_of_epoch(self, epoch: int) -> int:
+        """Map a WELCOME ``{"epoch": E}`` resume point to a seq: replay
+        starts after epoch E's marker record.  Caller holds _journal_mu."""
+        if epoch <= 0:
+            return 0    # a fresh receiver: everything
+        for seq, kind, a, _b in self._journal:
+            if kind == "e" and a == epoch:
+                return seq
+        if epoch == self._trimmed_epoch:
+            # trimmed/evicted exactly at this marker: the retained tail
+            # is exactly the records after it
+            return self._floor
+        raise ChannelError(
+            f"[resume] receiver resumes from epoch {epoch}, which this "
+            f"sender's journal cannot locate (sealed acks ran ahead or "
+            f"the epoch was never shipped) — cannot replay")
+
+    def _rs_replay(self, s: int) -> int:
+        """Re-transmit every journaled record with seq > ``s`` on the
+        (fresh) connection; returns the replay count."""
+        n = 0
+        with self._journal_mu:
+            todo = [rec for rec in self._journal if rec[0] > s]
+        for rec in todo:
+            self._transmit(rec)
+            n += 1
+        return n
+
+    def _journal_push(self, rec):
+        with self._journal_mu:
+            self._journal.append(rec)
+            if len(self._journal) > self._resume.journal_frames:
+                old = self._journal.popleft()
+                self._floor = max(self._floor, old[0])
+                if old[1] == "e":
+                    # evicting through a marker is equivalent to a trim
+                    # at it: the retained tail still resumes that epoch
+                    self._trimmed_epoch = old[2]
+            if self._tm is not None:
+                self._tm.journal_depth.set(len(self._journal))
+
+    def _apply_ack(self, w: dict):
+        """Trim the journal through a cumulative ACK (the reader
+        thread's half of the seal contract)."""
+        with self._journal_mu:
+            if "epoch" in w:
+                e = int(w["epoch"])
+                t = None
+                for seq, kind, a, _b in self._journal:
+                    if kind == "e" and a == e:
+                        t = seq
+                        break
+                if t is None:
+                    return   # already trimmed past it: idempotent no-op
+            else:
+                t = int(w["seq"])
+            while self._journal and self._journal[0][0] <= t:
+                old = self._journal.popleft()
+                if old[1] == "e":
+                    self._trimmed_epoch = old[2]
+            self._floor = max(self._floor, t)
+            if self._tm is not None:
+                self._tm.acks_recv.inc()
+                self._tm.journal_depth.set(len(self._journal))
+
+    def _start_ack_thread(self):
+        t = threading.Thread(target=self._ack_loop, args=(self._sock,),
+                             daemon=True, name="wf-rowsend-ack")
+        t.start()
+        self._ack_thread = t
+
+    def _ack_loop(self, sock):
+        """Owns recv on the resume connection: applies ACK frames and
+        marks the link down on EOF/reset so the next send resumes
+        proactively.  Exits silently when superseded by a reconnect
+        (its socket is no longer ``self._sock``) or on close()."""
+        try:
+            while not self._closing:
+                try:
+                    r, _, _ = select.select([sock], [], [], 0.25)
+                except (OSError, ValueError):
+                    return
+                if not r:
+                    continue
+                n = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+                if n != _RESUME_FRAME:
+                    raise ChannelError(
+                        f"unexpected frame {n} from receiver on resume "
+                        f"channel")
+                sub = _LEN.unpack(_read_exact(sock, _LEN.size))[0]
+                if sub != _RS_ACK:
+                    raise ChannelError(
+                        f"unexpected resume subtype {sub} from receiver")
+                self._apply_ack(_read_resume_json(sock))
+        except (OSError, ValueError):
+            if not self._closing and sock is self._sock:
+                self._link_down = True
+
+    def _transmit(self, rec):
+        """Write one journaled record's frames (SEQ tag + payload) on
+        the current connection; the single place the fault plan hooks.
+        Caller holds _send_lock."""
+        seq, kind, a, b = rec
+        act = None
+        if self._faults is not None:
+            self._fault_n += 1
+            act = self._faults.action_for(self._fault_n)
+        tm = self._tm
+        if kind == "e":
+            frame = (_LEN.pack(_RESUME_FRAME) + _LEN.pack(_RS_SEQ)
+                     + _LEN.pack(seq)
+                     + _LEN.pack(_EPOCH_FRAME) + _LEN.pack(a))
+        else:
+            if self._dtype_sent is None:
+                # dtype travels once per CONNECTION (not per stream):
+                # resent untagged after every reconnect
+                d = _encode_dtype(self._dtype)
+                self._sock.sendall(_LEN.pack(len(d)) + d)
+                self._dtype_sent = self._dtype
+                if tm is not None:
+                    tm.frames_sent.inc()
+                    tm.bytes_sent.inc(_LEN.size + len(d))
+            frame = (_LEN.pack(_RESUME_FRAME) + _LEN.pack(_RS_SEQ)
+                     + _LEN.pack(seq))
+            if a is not None:
+                frame += (_LEN.pack(_TRACE_FRAME) + _LEN.pack(len(a)) + a)
+                if tm is not None:
+                    tm.traces_sent.inc()
+            frame += _LEN.pack(len(b)) + b
+        if act == "kill":
+            self._sock.close()
+            raise ConnectionResetError(f"[fault] killed before record "
+                                       f"{self._fault_n}")
+        if act == "torn":
+            self._sock.sendall(frame[:max(1, len(frame) // 2)])
+            self._sock.close()
+            raise ConnectionResetError(f"[fault] torn frame at record "
+                                       f"{self._fault_n}")
+        if act == "stall":
+            stall_for = self._faults.stall_for
+            time.sleep(stall_for)
+            self._sock.close()
+            raise ConnectionResetError(f"[fault] stalled {stall_for}s "
+                                       f"then dropped at record "
+                                       f"{self._fault_n}")
+        self._sock.sendall(frame)
+        if act == "dup":
+            self._sock.sendall(frame)   # duplicated delivery: the
+            #                             receiver must dedup by seq
+        self._last_send = time.monotonic()
+        if tm is not None:
+            tm.frames_sent.inc()
+            tm.bytes_sent.inc(len(frame) * (2 if act == "dup" else 1))
+
+    def _deliver(self, rec):
+        """Transmit one record, entering the bounded resume cycle on any
+        link failure.  Caller holds _send_lock."""
+        if not self._link_down and self._hb_error is None:
+            try:
+                self._transmit(rec)
+                return
+            except ChannelError:
+                raise
+            except OSError as e:
+                err = e
+        else:
+            err = self._hb_error or ConnectionError(
+                "row channel link marked down by the ack reader")
+        self._resume_cycle(err)
+
+    def _resume_cycle(self, err):
+        """Reconnect + handshake + replay within the resume deadline
+        (the journaled record that just failed replays too).  Caller
+        holds _send_lock.  Raises :class:`ChannelError` once the
+        deadline is spent — the bounded-retry promise."""
+        rs = self._resume
+        tm = self._tm
+        if tm is not None:
+            tm.emit("wire_down", role="sender", host=self._host,
+                    port=self._port, error=type(err).__name__,
+                    message=str(err))
+        t_end = time.monotonic() + rs.deadline
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._hb_error = None
+        self._link_down = False
+        last = err
+        while True:
+            left = t_end - time.monotonic()
+            if left <= 0:
+                self._link_down = True
+                raise ChannelError(
+                    f"[resume] could not re-establish the row channel to "
+                    f"{self._host}:{self._port} within {rs.deadline}s; "
+                    f"last error: {last}") from last
+            try:
+                self._sock = _connect_with_backoff(
+                    self._host, self._port, self._timeout, left, tm=tm)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                self._dtype_sent = None
+                s = self._rs_handshake()
+                n = self._rs_replay(s)
+            except ChannelError:
+                raise   # protocol-fatal: journal cannot cover the tail
+            except OSError as e:
+                last = e
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                continue
+            break
+        if tm is not None:
+            tm.resumes.inc()
+            tm.replayed_frames.inc(n)
+            tm.emit("wire_resume", role="sender", replayed=n, from_seq=s,
+                    host=self._host, port=self._port)
+        self._start_ack_thread()
+        if (self._hb_interval is not None and self._hb_stop is not None
+                and not self._hb_stop.is_set()
+                and not self._hb_thread.is_alive()):
+            # the beat thread died with the old link: revive it
+            self._start_heartbeat()
+
+    def _transmit_eos(self):
+        """EOS on the current connection (resume path); preceded by the
+        dtype placeholder when this connection never carried one, so
+        the receiver's framing stays uniform.  Caller holds _send_lock."""
+        if self._dtype_sent is None:
+            d = _encode_dtype(self._dtype)
+            self._sock.sendall(_LEN.pack(len(d)) + d)
+            self._dtype_sent = self._dtype if self._dtype is not None \
+                else True
+        self._sock.sendall(_LEN.pack(_EOS_FRAME))
+
     # -- data path ---------------------------------------------------------
 
     def send(self, batch: np.ndarray, trace: dict = None):
@@ -382,6 +812,24 @@ class RowSender:
         keeps the bytes on the wire identical to the original
         protocol."""
         if len(batch) == 0:
+            return
+        if self._resume is not None:
+            # resume path: journal the record, then deliver (any link
+            # failure turns into the bounded reconnect/replay cycle)
+            if self._dtype is None:
+                self._dtype = batch.dtype
+            elif batch.dtype != self._dtype:
+                raise TypeError(
+                    f"row channel dtype changed mid-stream: "
+                    f"{self._dtype} -> {batch.dtype}")
+            tp = (json.dumps(trace).encode("utf-8")
+                  if trace is not None else None)
+            payload = np.ascontiguousarray(batch).tobytes()
+            with self._send_lock:
+                rec = (self._next_seq, "d", tp, payload)
+                self._next_seq += 1
+                self._journal_push(rec)
+                self._deliver(rec)
             return
         self._check_alive()
         with self._send_lock:
@@ -419,6 +867,13 @@ class RowSender:
         Like every hardening knob: never sent unless the application
         calls it, so the bytes on the wire stay seed-identical
         otherwise."""
+        if self._resume is not None:
+            with self._send_lock:
+                rec = (self._next_seq, "e", int(epoch), None)
+                self._next_seq += 1
+                self._journal_push(rec)
+                self._deliver(rec)
+            return
         self._check_alive()
         with self._send_lock:
             self._sock.sendall(_LEN.pack(_EPOCH_FRAME)
@@ -433,8 +888,31 @@ class RowSender:
         frame cannot be delivered (peer already dead) the failure is
         SURFACED — ``self.failed`` is set and :class:`ChannelError`
         raised — never reported as a clean shutdown: the peer may have
-        consumed a truncated stream."""
+        consumed a truncated stream.  With ``resume`` on, a dead link
+        gets one full resume cycle (reconnect + replay) before the EOS
+        is declared undeliverable."""
         self._stop_heartbeat()
+        if self._resume is not None:
+            err = None
+            try:
+                with self._send_lock:
+                    try:
+                        self._transmit_eos()
+                    except OSError as e:
+                        self._resume_cycle(e)   # ChannelError past the
+                        #                         deadline propagates
+                        self._transmit_eos()
+            except OSError as e:
+                err = e
+            self._closing = True
+            self._sock.close()
+            if err is not None:
+                self.failed = err
+                raise ChannelError(
+                    f"RowSender.close: EOS frame not delivered — peer "
+                    f"dead past the resume deadline (receiver may see a "
+                    f"truncated stream): {err}") from err
+            return
         err = self._hb_error
         try:
             if err is None:
@@ -464,6 +942,8 @@ class RowSender:
         it is called from error paths that must not mask the original
         failure."""
         self._stop_heartbeat()
+        if self._resume is not None:
+            self._closing = True   # the ack reader exits silently
         if self._tm is not None:
             self._tm.emit("peer_abort", role="sender")
         try:
@@ -489,7 +969,21 @@ class RowReceiver:
     def __init__(self, n_senders: int, host: str = "127.0.0.1",
                  port: int = 0, capacity: int = 64,
                  stall_timeout: float = None, accept_timeout: float = None,
-                 metrics=None, events=None, decode_trace: bool = False):
+                 metrics=None, events=None, decode_trace: bool = False,
+                 resume=None, resume_epoch: int = None, ack_epochs=None,
+                 wire: WireConfig = None):
+        if wire is not None:
+            wire.validate()
+            if stall_timeout is None:
+                stall_timeout = wire.stall_timeout
+            if accept_timeout is None:
+                accept_timeout = wire.connect_deadline
+            if resume is None:
+                resume = wire.resume
+            if ack_epochs is None:
+                # recovery= declares the sealed-ack loop is wired: the
+                # completed barriers of batches() ack automatically
+                ack_epochs = wire.recovery
         self._tm = _telemetry(metrics, events)  # see RowSender
         #: opt-in span passthrough: True re-attaches sender trace frames
         #: to their batches as :class:`TracedRows` (``batch.wf_trace``);
@@ -503,12 +997,34 @@ class RowReceiver:
         #: (the senders' connect_deadline), NOT to stall_timeout — hosts
         #: legitimately boot much slower than a live link may stall.
         self.accept_timeout = accept_timeout
+        self._resume = _as_resume(resume)
+        # acks only exist on the resume protocol: the flag is inert
+        # (and batches() stays seed-identical) without it
+        self._auto_ack = bool(ack_epochs) and self._resume is not None
         self._srv = socket.create_server((host, port),
                                          backlog=self.n_senders)
         self.host, self.port = self._srv.getsockname()[:2]
         self._q = queue.Queue(maxsize=capacity)
         self._conns: list[socket.socket] = []
-        self._accept_thread = threading.Thread(target=self._accept_loop,
+        if self._resume is not None:
+            #: restarted-receiver resume point: offered in WELCOME until
+            #: the first record lands on a channel, after which the last
+            #: contiguous seq takes over
+            self._resume_epoch = (None if resume_epoch is None
+                                  else int(resume_epoch))
+            self._mu = threading.Lock()
+            self._ack_mu = threading.Lock()
+            self._tokens: dict[str, int] = {}    # sender token -> idx
+            self._last_seq: dict[int, int] = {}  # idx -> last seq seen
+            self._gen: dict[int, int] = {}       # idx -> connection gen
+            self._conn_of: dict[int, socket.socket] = {}
+            self._finished: set[int] = set()
+            self._down: dict[int, threading.Timer] = {}
+            self._closed = False
+            target = self._accept_loop_resume
+        else:
+            target = self._accept_loop
+        self._accept_thread = threading.Thread(target=target,
                                                daemon=True,
                                                name="wf-rowrecv-accept")
         self._accept_thread.start()
@@ -556,6 +1072,312 @@ class RowReceiver:
                 for _ in range(self.n_senders - accepted):
                     self._q.put((None, failure))
                     self._q.put((None, None))
+
+    # -- resume protocol (docs/ROBUSTNESS.md "Wire resume") ----------------
+
+    def _accept_loop_resume(self):
+        """Resume-mode accept: the server socket stays open for the
+        receiver's whole life (reconnecting senders and late boots keep
+        arriving); each connection handshakes and reads on its own
+        thread.  The boot window (``accept_timeout``) still bounds how
+        long the FIRST connection of every sender may take."""
+        failure = None
+        accept_end = (time.monotonic() + float(self.accept_timeout)
+                      if self.accept_timeout is not None else None)
+        try:
+            while True:
+                with self._mu:
+                    if (self._closed
+                            or len(self._finished) >= self.n_senders):
+                        return
+                    booting = len(self._tokens) < self.n_senders
+                if booting and accept_end is not None:
+                    left = accept_end - time.monotonic()
+                    if left <= 0:
+                        raise socket.timeout()
+                    self._srv.settimeout(min(left, 0.5))
+                else:
+                    self._srv.settimeout(0.5)
+                try:
+                    conn, _addr = self._srv.accept()
+                except socket.timeout:
+                    continue
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if self.stall_timeout is not None:
+                    conn.settimeout(float(self.stall_timeout))
+                self._conns.append(conn)
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="wf-rowrecv").start()
+        except socket.timeout:
+            with self._mu:
+                known = len(self._tokens)
+            failure = PeerStall(
+                f"only {known}/{self.n_senders} senders connected "
+                f"within the {self.accept_timeout}s accept window")
+        except OSError:
+            with self._mu:
+                known = len(self._tokens)
+            failure = ChannelError(
+                f"row channel receiver closed with only {known}/"
+                f"{self.n_senders} senders connected")
+        finally:
+            self._srv.close()
+            if failure is not None:
+                for _ in range(self.n_senders - known):
+                    self._q.put((None, failure))
+                    self._q.put((None, None))
+
+    def _serve_conn(self, conn: socket.socket):
+        """HELLO -> idx assignment -> WELCOME -> read loop, one thread
+        per accepted connection.  A known token re-connecting replaces
+        its channel (generation bump) and resumes from the last
+        contiguous seq this receiver delivered."""
+        tm = self._tm
+        try:
+            n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+            sub = (_LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                   if n == _RESUME_FRAME else None)
+            if sub != _RS_HELLO:
+                raise ChannelError(
+                    f"resume receiver: expected HELLO, peer sent frame "
+                    f"{n}/{sub} (is the sender's resume= on?)")
+            token = str(_read_resume_json(conn).get("token"))
+            with self._mu:
+                if self._closed:
+                    conn.close()
+                    return
+                if token in self._tokens:
+                    idx = self._tokens[token]
+                    if idx in self._finished:
+                        conn.close()   # re-connect after its clean EOS
+                        return
+                else:
+                    if len(self._tokens) >= self.n_senders:
+                        conn.close()   # over-subscribed plane
+                        return
+                    idx = len(self._tokens)
+                    self._tokens[token] = idx
+                self._gen[idx] = gen = self._gen.get(idx, 0) + 1
+                self._conn_of[idx] = conn
+                timer = self._down.pop(idx, None)
+                last = self._last_seq.get(idx, 0)
+            if timer is not None:
+                timer.cancel()
+            if last == 0 and self._resume_epoch is not None:
+                welcome = {"epoch": self._resume_epoch}
+            else:
+                welcome = {"seq": last}
+            _send_resume_frame(conn, _RS_WELCOME, welcome)
+        except (OSError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        if gen > 1 and tm is not None:
+            tm.resumes.inc()
+            tm.emit("wire_resume", role="receiver", sender=idx,
+                    resume_point=welcome)
+        self._read_loop_resume(conn, idx, gen)
+
+    def _rs_fresh(self, idx: int, seq) -> bool:
+        """Seq dedup, exactly the in-process ``_run_supervised`` rule:
+        a record at or below the last seq seen on this channel is a
+        replayed duplicate and drops."""
+        if seq is None:
+            return True   # an untagged peer (no resume): no dedup
+        with self._mu:
+            if seq <= self._last_seq.get(idx, 0):
+                return False
+            self._last_seq[idx] = seq
+            return True
+
+    def _rs_channel_down(self, idx: int, gen: int, err: Exception):
+        """A resumable channel broke: instead of failing batches() now,
+        arm the resume deadline — a reconnect cancels it; expiry
+        surfaces the original error (the bounded-retry promise)."""
+        with self._mu:
+            if idx in self._finished or self._gen.get(idx) != gen:
+                return   # superseded by a newer connection
+            self._conn_of.pop(idx, None)
+            if self._closed:
+                # receiver torn down: no reconnect is coming — wake a
+                # consumer still blocked in batches() with the error
+                # now, like the non-resume reader does (puts outside
+                # the lock: a full queue must not hold _mu hostage)
+                self._finished.add(idx)
+                closed = True
+            else:
+                closed = False
+        if closed:
+            self._q.put((idx, err))
+            self._q.put((idx, None))
+            return
+        with self._mu:
+            if idx in self._finished or self._gen.get(idx) != gen:
+                return
+            t = threading.Timer(self._resume.deadline, self._rs_expire,
+                                args=(idx, gen, err))
+            t.daemon = True
+            self._down[idx] = t
+            t.start()
+        if self._tm is not None:
+            self._tm.emit("wire_down", role="receiver", sender=idx,
+                          error=type(err).__name__, message=str(err))
+
+    def _rs_expire(self, idx: int, gen: int, err: Exception):
+        with self._mu:
+            if (self._closed or self._down.pop(idx, None) is None
+                    or self._gen.get(idx) != gen):
+                return
+            self._finished.add(idx)
+        self._q.put((idx, err))
+        self._q.put((idx, None))
+
+    def _next_frame_resume(self, conn: socket.socket, pending):
+        """Resume-mode framing: like :meth:`_next_frame` but understands
+        the ``-6`` family — returns ``(frame, trace, seq)`` where seq is
+        the SEQ tag announced for this record (None for untagged)."""
+        tm = self._tm
+        trace = None
+        while True:
+            n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+            if n >= 0:
+                raw = _read_exact(conn, n)
+                if tm is not None:
+                    tm.frames_recv.inc()
+                    tm.bytes_recv.inc(_LEN.size + n)
+                return raw, trace, pending
+            if n == _EOS_FRAME:
+                return None, None, None
+            if n == _HEARTBEAT_FRAME:
+                if tm is not None:
+                    tm.heartbeats_recv.inc()
+                continue
+            if n == _EPOCH_FRAME:
+                epoch = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                if tm is not None:
+                    tm.frames_recv.inc()
+                    tm.bytes_recv.inc(2 * _LEN.size)
+                from ..recovery.epoch import EpochMarker
+                return EpochMarker(epoch), None, pending
+            if n == _TRACE_FRAME:
+                tn = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                if not 0 <= tn <= (1 << 20):
+                    raise ChannelError(
+                        f"bad trace-frame payload length {tn}")
+                tp = _read_exact(conn, tn)
+                if tm is not None:
+                    tm.traces_recv.inc()
+                    tm.bytes_recv.inc(2 * _LEN.size + tn)
+                if self.decode_trace:
+                    trace = json.loads(tp.decode("utf-8"))
+                continue
+            if n == _RESUME_FRAME:
+                sub = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                if sub != _RS_SEQ:
+                    raise ChannelError(
+                        f"unexpected resume subtype {sub} mid-stream")
+                pending = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+                continue
+            if n == _ABORT_FRAME:
+                if tm is not None:
+                    tm.emit("peer_abort", role="receiver")
+                raise PeerAbort(
+                    "row channel sender ABORTED mid-stream (its process "
+                    "failed): data received so far is a truncated "
+                    "prefix, not a complete stream")
+            raise ChannelError(f"bad row-channel frame length {n}")
+
+    def _read_loop_resume(self, conn: socket.socket, idx: int, gen: int):
+        from ..recovery.epoch import EpochMarker
+        try:
+            dtype = None
+            got_dtype = False
+            pending = None
+            while True:
+                raw, trace, pending = self._next_frame_resume(conn,
+                                                              pending)
+                if raw is None:
+                    break   # clean EOS
+                if type(raw) is EpochMarker:
+                    if self._rs_fresh(idx, pending):
+                        self._q.put((idx, raw))
+                    pending = None
+                    continue
+                if not got_dtype:
+                    # first payload frame of a connection is its dtype
+                    # (resent per connection, never SEQ-tagged)
+                    dtype = _decode_dtype(raw)
+                    got_dtype = True
+                    continue
+                fresh = self._rs_fresh(idx, pending)
+                pending = None
+                if not fresh:
+                    continue   # duplicate delivery: drop (trace too)
+                arr = np.frombuffer(raw, dtype=dtype).copy()
+                if trace is not None:
+                    arr = arr.view(TracedRows)
+                    arr.wf_trace = trace
+                self._q.put((idx, arr))
+        except PeerAbort as e:
+            # a deliberate mid-stream failure is NOT resumable: the
+            # sender's process declared itself dead
+            conn.close()
+            with self._mu:
+                self._finished.add(idx)
+                self._conn_of.pop(idx, None)
+            self._q.put((idx, e))
+            self._q.put((idx, None))
+            return
+        except socket.timeout as e:
+            stall = PeerStall(
+                f"row channel peer silent for {self.stall_timeout}s "
+                f"(no data or heartbeat): stalled mid-stream or "
+                f"partitioned")
+            stall.__cause__ = e
+            if self._tm is not None:
+                self._tm.emit("peer_stall",
+                              stall_timeout=self.stall_timeout)
+            conn.close()
+            self._rs_channel_down(idx, gen, stall)
+            return
+        except Exception as e:  # noqa: BLE001 — any other reader failure
+            # (EOF/RST mid-frame, torn frame, undecodable dtype) arms
+            # the resume deadline: the sender gets that long to
+            # reconnect and replay before the error surfaces
+            conn.close()
+            self._rs_channel_down(idx, gen, e)
+            return
+        conn.close()
+        with self._mu:
+            self._finished.add(idx)
+            self._conn_of.pop(idx, None)
+        self._q.put((idx, None))   # this sender is done
+
+    def ack_epoch(self, epoch: int):
+        """Cumulative sealed-epoch acknowledgement: tell every live
+        sender that everything up to and including epoch ``epoch`` is
+        durably incorporated on this side, so their journals trim
+        through that marker (the journal-bound guarantee).  Call it when
+        the epoch is SEALED (checkpoint committed — e.g. from
+        ``Dataflow.on_epoch_sealed``); a receiver built with
+        ``WireConfig(recovery=True)`` acks automatically as barriers
+        complete in :meth:`batches`.  A link that is down simply keeps
+        its journal — the next (cumulative) ack trims it."""
+        if self._resume is None:
+            raise RuntimeError("ack_epoch needs a resume= receiver")
+        with self._mu:
+            conns = list(self._conn_of.values())
+        for conn in conns:
+            try:
+                with self._ack_mu:
+                    _send_resume_frame(conn, _RS_ACK,
+                                       {"epoch": int(epoch)})
+                if self._tm is not None:
+                    self._tm.acks_sent.inc()
+            except OSError:
+                pass
 
     def _next_frame(self, conn: socket.socket):
         """One payload frame as ``(frame, trace_or_None)`` — ``frame``
@@ -718,6 +1540,10 @@ class RowReceiver:
             # to cover and must precede it; rows at exactly L == m open
             # the next epoch and follow it.
             my_epoch = m
+            if self._auto_ack:
+                # WireConfig(recovery=True): a completed barrier is this
+                # plane's seal point — ack it so sender journals trim
+                self.ack_epoch(m)
             for i in sorted(held):
                 keep = []
                 for lvl, row in held[i]:
@@ -747,6 +1573,13 @@ class RowReceiver:
         see a reset on their next send, and a consumer blocked in
         batches() during the accept phase is woken with a classified
         error — fail fast, not hang."""
+        if self._resume is not None:
+            with self._mu:
+                self._closed = True
+                timers = list(self._down.values())
+                self._down.clear()
+            for t in timers:
+                t.cancel()
         try:
             # closing an fd does NOT wake a thread blocked in accept();
             # shutdown() does (Linux: accept returns EINVAL)
@@ -755,6 +1588,15 @@ class RowReceiver:
             pass
         self._srv.close()
         for conn in self._conns:
+            try:
+                # as with accept() above: close() alone neither wakes a
+                # reader thread blocked in recv() nor reliably FINs the
+                # peer while one is — shutdown() does both, so a
+                # resumable sender's ack reader sees the EOF and marks
+                # the link down promptly
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:
@@ -769,7 +1611,13 @@ def partition_and_ship(batch: np.ndarray, owners: np.ndarray, my_pid: int,
     ``senders[pid]`` RowSender.  The one-call form of the multi-host
     source contract for non-key-partitioned inputs.  ``trace``
     (typically ``obs.trace.export()``) rides with every shipped part so
-    a sampled batch's span survives the hop."""
+    a sampled batch's span survives the hop.
+
+    With resumable senders (``open_row_plane(resume=...)``) each
+    shipped part is journaled under a seq before it hits the wire, so a
+    peer restart mid-call replays the missing parts transparently —
+    callers need no try/except around the ship loop; a failure raised
+    here means the resume deadline itself was exhausted."""
     mine = batch[owners == my_pid]
     covered = np.isin(owners, [my_pid, *senders])
     if not covered.all():

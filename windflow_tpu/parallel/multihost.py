@@ -127,7 +127,8 @@ def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
 
 def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                    wire=None, metrics=None, events=None,
-                   decode_trace: bool = False):
+                   decode_trace: bool = False, resume=None,
+                   resume_epoch: int = None):
     """Build the full cross-host row data plane for a process: one
     :class:`~windflow_tpu.parallel.channel.RowReceiver` listening at
     ``addresses[my_pid]`` and one hardened
@@ -161,7 +162,22 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     (``send(..., trace=obs.trace.export())`` on the peer) to their
     batches as ``TracedRows`` so a traced source on this host adopts
     them and the multihost graph stitches one trace
-    (docs/OBSERVABILITY.md §tracing); the default discards them."""
+    (docs/OBSERVABILITY.md §tracing); the default discards them.
+
+    ``resume`` (``True`` or a tuned
+    :class:`~windflow_tpu.parallel.channel.WireResume`; default taken
+    from ``wire.resume``) makes every edge of this plane *resumable*
+    (docs/ROBUSTNESS.md "Wire resume"): senders journal outbound frames
+    and replay the unacked tail over a fresh connection when a peer
+    restarts, receivers dedup by seq — so peer death inside the resume
+    deadline becomes a bounded retry instead of a graph error.  A
+    RESTARTED process reopening its half of the plane passes
+    ``resume_epoch=K`` (its last sealed checkpoint epoch): its receiver
+    then asks each reconnecting sender to replay from the epoch-``K``
+    barrier rather than from a seq it no longer remembers, which is
+    exactly the wire tail the restored dataflow needs.  Unset (and
+    unset on ``wire``) ⇒ the plane behaves byte-identically to before
+    (no journal, no handshake)."""
     from .channel import RowReceiver, RowSender, WireConfig
     if my_pid not in addresses:
         raise KeyError(f"addresses has no entry for this process "
@@ -172,13 +188,14 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     host, port = addresses[my_pid]
     receiver = RowReceiver(n_senders=len(addresses) - 1, host=host,
                            port=port, capacity=capacity,
-                           stall_timeout=wire.stall_timeout,
-                           # a peer that dies before ever connecting must
-                           # surface within the boot-order budget, not
-                           # hang batches() forever
-                           accept_timeout=wire.connect_deadline,
+                           # wire= supplies stall_timeout and the
+                           # accept deadline (a peer that dies before
+                           # ever connecting must surface within the
+                           # boot-order budget, not hang batches())
                            metrics=metrics, events=events,
-                           decode_trace=decode_trace)
+                           decode_trace=decode_trace,
+                           resume=resume, resume_epoch=resume_epoch,
+                           wire=wire)
     senders = {}
     try:
         for pid in sorted(addresses):
@@ -186,10 +203,9 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                 continue
             peer_host, peer_port = addresses[pid]
             senders[pid] = RowSender(
-                peer_host, peer_port, timeout=wire.connect_timeout,
-                connect_deadline=wire.connect_deadline,
-                heartbeat=wire.heartbeat,
-                metrics=metrics, events=events)
+                peer_host, peer_port,
+                metrics=metrics, events=events,
+                resume=resume, wire=wire)
     except Exception:
         for snd in senders.values():
             snd.abort()
@@ -205,7 +221,14 @@ def ship_epoch(senders: dict, epoch: int, my_pid: int = None):
     that injects epoch ``e`` locally calls this so remote consumers'
     ``batches(epoch_markers=True)`` aligns on the same boundary.  Call
     it AFTER the epoch's last ``partition_and_ship`` — the frame
-    promises every row of epochs <= ``e`` is already on the wire."""
+    promises every row of epochs <= ``e`` is already on the wire.
+
+    On a resumable plane (``open_row_plane(resume=...)``) the epoch
+    frame is also the journal's unit of truncation: once the remote
+    receiver acks epoch ``e`` (automatic under ``WireConfig(recovery=
+    True)``), every journaled frame up to and including this barrier is
+    dropped — so calling ``ship_epoch`` at your checkpoint cadence is
+    what keeps sender journals bounded by one epoch's width."""
     for pid, snd in senders.items():
         if my_pid is not None and pid == my_pid:
             continue

@@ -250,6 +250,16 @@ class Supervisor:
                         nodes=len(blobs), partial=partial,
                         bytes=sum(m.get("bytes", 0)
                                   for m in blobs.values()))
+            # the seal is the durability boundary resumable wire edges
+            # ack at (Dataflow.on_epoch_sealed → RowReceiver.ack_epoch
+            # → sender journals trim).  Read live so listeners
+            # registered after run() still fire; swallow — a telemetry
+            # hook must not fail a seal.
+            for fn in getattr(self.dataflow, "_seal_listeners", ()):
+                try:
+                    fn(epoch)
+                except Exception:
+                    pass
 
     def stop(self, wait_s: float = 30.0):
         """Flush and stop the writer (called from ``Dataflow.wait``).

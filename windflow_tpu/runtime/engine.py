@@ -440,6 +440,12 @@ class Dataflow:
         #: to check/ lazily, so the unset default never imports it
         self.check = check
         self._supervisor = None
+        #: callbacks fired (epoch:int) each time the supervisor seals a
+        #: checkpoint epoch manifest — the hook the resumable row plane
+        #: uses to ack sealed epochs back to remote senders so their
+        #: journals trim (docs/ROBUSTNESS.md "Wire resume").  Read live
+        #: by Supervisor._seal_ready, so registration after run() works.
+        self._seal_listeners: list = []
         if sample_period is None:
             sample_period = default_sample_period()
         if sample_period is not None and float(sample_period) <= 0:
@@ -570,6 +576,21 @@ class Dataflow:
         slot = inbox.register_source()
         src._outputs.append((inbox, slot))
         self._edges.append((src, dst))
+
+    def on_epoch_sealed(self, fn):
+        """Register ``fn(epoch)`` to fire each time the recovery
+        supervisor seals a checkpoint epoch (every expected node's blob
+        committed).  This is the durability boundary a resumable wire
+        edge cares about: wiring ``receiver.ack_epoch`` here acks
+        sealed epochs back to remote RowSenders so their replay
+        journals trim (docs/ROBUSTNESS.md "Wire resume").  Listeners
+        run on the checkpoint-writer thread; exceptions are swallowed
+        (a telemetry hook must not fail a seal).  Requires
+        ``recovery=`` with a checkpoint_dir — without a store nothing
+        ever seals, so the hook never fires.  Returns ``fn`` for
+        decorator use."""
+        self._seal_listeners.append(fn)
+        return fn
 
     # ------------------------------------------------------------------ run
 
