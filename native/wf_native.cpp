@@ -107,6 +107,13 @@ struct KeyState {
     i64 pend_vmin[kMaxFields] = {0}, pend_vmax[kMaxFields] = {0};
     bool pend_any = false;
     int row = -1;             // dense ring row
+    // key migrated away at a rescale barrier (wf_core_key_neutralize):
+    // eos() and the state ABI skip it so the old owner never emits its
+    // windows again; a late row for the key clears the flag and the key
+    // restarts from fresh state (same as first contact on a new owner).
+    // The dense row itself stays registered — queued launches and wrow
+    // entries index rows by position, so rows are never renumbered.
+    bool neutral = false;
 
     inline void note_vals(int nf, const i64 *vs) {
         if (!pend_any) {
@@ -570,6 +577,8 @@ struct Core {
         std::vector<KeyState *> sts((size_t)P);
         for (i64 k = 0; k < P; ++k) {
             KeyState &st = state(key_of[(size_t)k]);
+            if (st.neutral)   // general loop clears the flag per row
+                return 0;
             if (nextpos[(size_t)k] < st.last_pos
                 || nextpos[(size_t)k] < st.initial_id)
                 return 0;
@@ -720,6 +729,7 @@ struct Core {
             std::memcpy(&val, rp + o_val, 8);
             const bool mk = rp[o_marker] != 0;
             KeyState &st = state(key);
+            if (st.neutral) st.neutral = false;
             const i64 pos = (kind == CB) ? id : tsv;
             if (pos < st.last_pos) continue;       // out-of-order drop
             st.last_pos = pos;
@@ -769,6 +779,7 @@ struct Core {
         const i64 q0 = launches_made;
         for (size_t r = 0; r < keys.size(); ++r) {
             KeyState &st = keys[r];
+            if (st.neutral) continue;   // key migrated away at a rescale
             if (st.n_fired < st.next_lwid) {
                 const i64 from = st.n_fired;
                 st.n_fired = st.next_lwid;
@@ -1669,6 +1680,310 @@ i64 wf_keyscan_ordered(const i64 *slots, const i64 *pos, i64 n,
     }
     *n_touched = nt;
     return ok;
+}
+
+// ---------------------------------------------------------------- state ABI
+// Exactly-once checkpoint / keyed-migration support (docs/ROBUSTNESS.md
+// "Native state ABI").  Blobs are flat little-endian i64 streams: a tagged
+// header (magic, ABI version, config echo) followed by per-key records —
+// the archive rows still needed by future windows plus the window/ordering
+// counters.  Export REQUIRES a drained core (no pending rows, no pending
+// fired windows, empty launch queue): the Python barrier protocol
+// force-flushes and drains first, so device ring contents never cross the
+// ABI — import zeroes the ring geometry (cap = 0) and the next flush
+// rebases, re-shipping every live row from the imported archives exactly
+// like the no-ring-snapshot restore path of the Python resident core.
+//
+// kStateAbiVersion stamps every blob and is exposed via wf_abi_version();
+// tests compare it against the source constant to catch a stale .so.
+
+static const i64 kStateAbiVersion = 1;
+static const i64 kStateMagicCore = 0x57464E5354415445LL;  // "WFNSTATE"
+static const i64 kStateMagicKey = 0x57464E534B455931LL;   // "WFNSKEY1"
+
+i64 wf_abi_version(void) { return kStateAbiVersion; }
+
+namespace {
+
+struct StateWr {
+    u8 *p;
+    const u8 *end;
+    bool ok = true;
+    void put(i64 v) {
+        if (p + 8 > end) { ok = false; return; }
+        std::memcpy(p, &v, 8);
+        p += 8;
+    }
+    void put_arr(const i64 *a, size_t n) {
+        if (n == 0) return;
+        if (p + 8 * n > end) { ok = false; return; }
+        std::memcpy(p, a, n * 8);
+        p += n * 8;
+    }
+};
+
+struct StateRd {
+    const u8 *p;
+    const u8 *end;
+    bool ok = true;
+    i64 get() {
+        if (p + 8 > end) { ok = false; return 0; }
+        i64 v;
+        std::memcpy(&v, p, 8);
+        p += 8;
+        return v;
+    }
+    bool get_arr(i64 *a, size_t n) {
+        if (n == 0) return true;
+        if (p + 8 * n > end) { ok = false; return false; }
+        std::memcpy(a, p, n * 8);
+        p += n * 8;
+        return true;
+    }
+};
+
+// export/import precondition: everything the core buffers between the
+// append path and the device has been flushed and shipped.  pend_rows == 0
+// also implies launched == appended for every key (each append bumps
+// pend_rows; only flush() clears it, setting launched = appended).
+inline bool core_drained(Core *c) {
+    if (c->pend_rows != 0 || !c->wrow.empty()) return false;
+    std::lock_guard<std::mutex> lk(c->qmu);
+    return c->queue.empty();
+}
+
+inline int find_row(Core *c, i64 key) {
+    if (key >= 0 && key < (i64)c->direct.size())
+        return c->direct[(size_t)key];
+    auto it = c->rowmap.find(key);
+    return it == c->rowmap.end() ? -1 : it->second;
+}
+
+inline i64 key_rec_i64s(const Core *c, const KeyState &st) {
+    return 11 + (i64)st.live() * (2 + c->n_fields);
+}
+
+void export_key(const Core *c, const KeyState &st, i64 key, StateWr &w) {
+    const i64 L = (i64)st.live();
+    w.put(key);
+    w.put(st.appended);
+    w.put(st.last_pos);
+    w.put(st.initial_id);
+    w.put(st.first_gwid);
+    w.put(st.next_lwid);
+    w.put(st.n_fired);
+    w.put(st.emit_counter);
+    w.put(st.marker_pos);
+    w.put(st.marker_ts);
+    w.put(L);
+    w.put_arr(st.pos.data() + st.start, (size_t)L);
+    w.put_arr(st.ts.data() + st.start, (size_t)L);
+    w.put_arr(st.val.data() + st.start, (size_t)L);
+    for (int f = 1; f < c->n_fields; ++f)
+        w.put_arr(st.xval[(size_t)(f - 1)].data() + st.start, (size_t)L);
+}
+
+bool import_key(Core *c, StateRd &r) {
+    const i64 key = r.get();
+    const i64 appended = r.get(), last_pos = r.get();
+    const i64 initial_id = r.get(), first_gwid = r.get();
+    const i64 next_lwid = r.get(), n_fired = r.get();
+    const i64 emit_counter = r.get(), marker_pos = r.get();
+    const i64 marker_ts = r.get();
+    const i64 L = r.get();
+    if (!r.ok || L < 0 || appended < L) return false;
+    KeyState &st = c->state(key);
+    if (!st.neutral && !(st.appended == 0 && st.n_fired == 0
+                         && st.last_pos <= NEG_INF))
+        return false;   // live state on the importing side: refuse
+    st.pos.assign((size_t)L, 0);
+    st.ts.assign((size_t)L, 0);
+    st.val.assign((size_t)L, 0);
+    if (!r.get_arr(st.pos.data(), (size_t)L)) return false;
+    if (!r.get_arr(st.ts.data(), (size_t)L)) return false;
+    if (!r.get_arr(st.val.data(), (size_t)L)) return false;
+    for (int f = 1; f < c->n_fields; ++f) {
+        auto &xv = st.xval[(size_t)(f - 1)];
+        xv.assign((size_t)L, 0);
+        if (!r.get_arr(xv.data(), (size_t)L)) return false;
+    }
+    st.start = 0;
+    st.appended = appended;
+    st.last_pos = last_pos;
+    st.initial_id = initial_id;
+    st.first_gwid = first_gwid;
+    st.next_lwid = next_lwid;
+    st.n_fired = n_fired;
+    st.emit_counter = emit_counter;
+    st.marker_pos = marker_pos;
+    st.marker_ts = marker_ts;
+    st.purge_pos = NEG_INF;
+    st.pend_any = false;
+    st.neutral = false;
+    // nothing of this key is in any ring (the caller zeroes cap so the
+    // next flush rebases and re-ships the live rows)
+    st.launched = st.ring_base = appended - L;
+    st.next_create = st.initial_id + st.next_lwid * c->slide;
+    st.fire_pos = st.initial_id + st.n_fired * c->slide + c->win;
+    return true;
+}
+
+}  // namespace
+
+// Whole-core blob: header (magic, abi, win, slide, kind, role, n_fields,
+// room_mult, launches_made, n_keys) + one record per non-neutral key.
+// Size/export return -1 when the core is not drained.
+i64 wf_core_state_size(void *h) {
+    Core *c = (Core *)h;
+    if (!core_drained(c)) return -1;
+    i64 n = 10;
+    for (auto &st : c->keys)
+        if (!st.neutral) n += key_rec_i64s(c, st);
+    return n * 8;
+}
+
+i64 wf_core_state_export(void *h, void *buf, i64 cap) {
+    Core *c = (Core *)h;
+    if (!core_drained(c)) return -1;
+    StateWr w{(u8 *)buf, (const u8 *)buf + cap};
+    w.put(kStateMagicCore);
+    w.put(kStateAbiVersion);
+    w.put(c->win);
+    w.put(c->slide);
+    w.put((i64)c->kind);
+    w.put((i64)c->role);
+    w.put((i64)c->n_fields);
+    w.put(c->room_mult);
+    w.put(c->launches_made);
+    i64 nk = 0;
+    for (auto &st : c->keys)
+        if (!st.neutral) ++nk;
+    w.put(nk);
+    for (size_t r = 0; r < c->keys.size(); ++r) {
+        if (c->keys[r].neutral) continue;
+        export_key(c, c->keys[r], c->rowkey[r], w);
+    }
+    if (!w.ok) return -1;
+    return (i64)(w.p - (u8 *)buf);
+}
+
+// Import requires a FRESH core (same wf_core_new config, no keys, empty
+// queue) — restore builds new handles rather than scrubbing live ones.
+// Returns 0 on success; negative codes name the refusal (-2 not fresh,
+// -3 bad magic, -4 ABI version mismatch, -5 config echo mismatch,
+// -6 truncated/invalid records).
+i64 wf_core_state_import(void *h, const void *buf, i64 nbytes) {
+    Core *c = (Core *)h;
+    if (!c->keys.empty() || c->pend_rows != 0) return -2;
+    {
+        std::lock_guard<std::mutex> lk(c->qmu);
+        if (!c->queue.empty()) return -2;
+    }
+    StateRd r{(const u8 *)buf, (const u8 *)buf + nbytes};
+    if (r.get() != kStateMagicCore) return -3;
+    if (r.get() != kStateAbiVersion) return -4;
+    if (r.get() != c->win || r.get() != c->slide
+        || r.get() != (i64)c->kind || r.get() != (i64)c->role
+        || r.get() != (i64)c->n_fields)
+        return -5;
+    c->room_mult = r.get();
+    c->launches_made = r.get();
+    const i64 nk = r.get();
+    if (!r.ok || nk < 0) return -6;
+    for (i64 i = 0; i < nk; ++i)
+        if (!import_key(c, r)) return -6;
+    // ring geometry resets: the next flush rebases and re-ships every
+    // live row from the imported archives (device state never crosses)
+    c->KP = 0;
+    c->cap = 0;
+    return 0;
+}
+
+// -- per-key variants (control-plane keyed migration) -----------------------
+
+i64 wf_core_key_count(void *h) {
+    Core *c = (Core *)h;
+    i64 n = 0;
+    for (auto &st : c->keys)
+        if (!st.neutral) ++n;
+    return n;
+}
+
+i64 wf_core_key_list(void *h, i64 *out, i64 cap) {
+    Core *c = (Core *)h;
+    i64 n = 0;
+    for (size_t r = 0; r < c->keys.size(); ++r) {
+        if (c->keys[r].neutral) continue;
+        if (n < cap) out[n] = c->rowkey[r];
+        ++n;
+    }
+    return n;
+}
+
+i64 wf_core_key_state_size(void *h, i64 key) {
+    Core *c = (Core *)h;
+    if (!core_drained(c)) return -1;
+    const int row = find_row(c, key);
+    if (row < 0 || c->keys[(size_t)row].neutral) return -2;
+    return (3 + key_rec_i64s(c, c->keys[(size_t)row])) * 8;
+}
+
+i64 wf_core_key_export(void *h, i64 key, void *buf, i64 cap) {
+    Core *c = (Core *)h;
+    if (!core_drained(c)) return -1;
+    const int row = find_row(c, key);
+    if (row < 0 || c->keys[(size_t)row].neutral) return -2;
+    StateWr w{(u8 *)buf, (const u8 *)buf + cap};
+    w.put(kStateMagicKey);
+    w.put(kStateAbiVersion);
+    w.put((i64)c->n_fields);
+    export_key(c, c->keys[(size_t)row], key, w);
+    if (!w.ok) return -1;
+    return (i64)(w.p - (u8 *)buf);
+}
+
+// Move semantics for migration: after exporting, the old owner
+// neutralizes the key — archives and counters reset to fresh-registration
+// values, eos()/export skip it — so the migrated key's windows are never
+// emitted twice.  The dense row stays registered (launch descriptors
+// index rows by position).
+i64 wf_core_key_neutralize(void *h, i64 key) {
+    Core *c = (Core *)h;
+    if (!core_drained(c)) return -1;
+    const int row = find_row(c, key);
+    if (row < 0) return -2;
+    KeyState &st = c->keys[(size_t)row];
+    st.pos.clear();
+    st.ts.clear();
+    st.val.clear();
+    for (auto &xv : st.xval) xv.clear();
+    st.start = 0;
+    st.appended = st.launched = st.ring_base = 0;
+    st.last_pos = NEG_INF;
+    st.next_lwid = st.n_fired = 0;
+    st.emit_counter = (c->role == MAP) ? c->map_idx0 : 0;
+    st.marker_pos = NEG_INF;
+    st.marker_ts = 0;
+    st.purge_pos = NEG_INF;
+    st.pend_any = false;
+    st.next_create = st.initial_id;
+    st.fire_pos = st.initial_id + c->win;
+    st.neutral = true;
+    return 0;
+}
+
+i64 wf_core_key_import(void *h, const void *buf, i64 nbytes) {
+    Core *c = (Core *)h;
+    if (!core_drained(c)) return -1;
+    StateRd r{(const u8 *)buf, (const u8 *)buf + nbytes};
+    if (r.get() != kStateMagicKey) return -3;
+    if (r.get() != kStateAbiVersion) return -4;
+    if (r.get() != (i64)c->n_fields) return -5;
+    if (!r.ok || !import_key(c, r)) return -6;
+    // the imported rows are in no ring: force a rebase at the next flush
+    c->KP = 0;
+    c->cap = 0;
+    return 0;
 }
 
 }  // extern "C"

@@ -11,6 +11,14 @@ is reproducible in isolation:
 
 The test suite runs a small slow-marked slice of this via
 tests/test_recovery.py (tier-1 excludes it with -m 'not slow').
+
+--native runs the same differential over the C++ resident core's state
+ABI (docs/ROBUSTNESS.md "Native state ABI"): randomized WinSeqTPU
+graphs that route to NativeResidentCore, killed mid-stream and
+restored from the exported blob:
+
+    python scripts/soak_crash.py --native --n 50 --seed 11
+    python scripts/soak_crash.py --native --seed 11 --case 7
 """
 
 import argparse
@@ -133,20 +141,121 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
     return params
 
 
+class NativeUnavailable(RuntimeError):
+    """--native requested but no state-ABI native core on this host."""
+
+
+def run_case_native(seed: int, case: int, verbose: bool = False) -> dict:
+    """One randomized crash-recovery case over the C++ resident core:
+    a WinSeqTPU graph routed to NativeResidentCore is killed mid-stream
+    and its state restored through the blob ABI; output must match the
+    uncrashed differential oracle byte-for-byte."""
+    from windflow_tpu import RecoveryPolicy, Reducer, Sink, Source
+    from windflow_tpu.core.tuples import Schema
+    from windflow_tpu.core.windows import WinType
+    from windflow_tpu.native import enabled
+    from windflow_tpu.patterns.native_core import NativeResidentCore
+    from windflow_tpu.patterns.win_seq_tpu import WinSeqTPU
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+
+    lib = enabled()
+    if lib is None or not getattr(lib, "wf_has_state_abi", False):
+        raise NativeUnavailable(
+            "native library with the state ABI unavailable (build with "
+            "`make -C native`, unset WF_NO_NATIVE)")
+
+    rng = np.random.default_rng((seed, case, 0x4e41))
+    schema = Schema(value=np.int64)
+    n_batches = int(rng.integers(10, 32))
+    rows = int(rng.integers(16, 80))
+    n_keys = int(rng.integers(1, 8))
+    win = int(rng.integers(2, 16))
+    slide = int(rng.integers(1, win + 1))
+    win_type = WinType.CB if rng.random() < 0.7 else WinType.TB
+    batch_len = int(rng.choice([16, 32, 64]))
+    shards = int(rng.integers(1, 3))
+    epoch_batches = int(rng.integers(2, 10))
+    n_kills = int(rng.integers(1, 3))
+    kill_at = sorted(set(
+        rng.integers(1, max(n_batches, 2), size=n_kills).tolist()))
+    params = dict(n_batches=n_batches, rows=rows, n_keys=n_keys, win=win,
+                  slide=slide, win_type=win_type.name, batch_len=batch_len,
+                  shards=shards, epoch_batches=epoch_batches,
+                  kill_at=kill_at)
+    repro = f"python scripts/soak_crash.py --native --seed {seed} " \
+            f"--case {case}"
+    if verbose:
+        print(f"native case {case}: {params}")
+
+    def run(recovery=None, kills=()):
+        out = []
+        df = Dataflow(f"nsoak{case}", capacity=8, recovery=recovery)
+        build_pipeline(df, [
+            Source(batches=lambda i: _batches(schema, n_batches, rows,
+                                              n_keys, seed + case),
+                   name="src"),
+            WinSeqTPU(Reducer("sum", "value"), win, slide, win_type,
+                      batch_len=batch_len, shards=shards, name="w"),
+            Sink(lambda r: out.append((int(r["key"]), int(r["id"]),
+                                       int(r["value"])))
+                 if r is not None else None, name="sink"),
+        ])
+        node = next(n for n in df.nodes
+                    if n.name == "w" or n.name.startswith("w."))
+        if not isinstance(node.core, NativeResidentCore):
+            raise NativeUnavailable(
+                f"routing picked {type(node.core).__name__}, not the "
+                f"native core, on this host")
+        state = {"n": 0, "todo": sorted(kills, reverse=True)}
+        orig = node.svc
+
+        def svc(batch, channel=0):
+            state["n"] += 1
+            if state["todo"] and state["n"] >= state["todo"][-1]:
+                state["todo"].pop()
+                raise RuntimeError(f"{repro}: injected crash "
+                                   f"@svc {state['n']}")
+            return orig(batch, channel)
+
+        node.svc = svc
+        df.run_and_wait_end(timeout=300)
+        return out
+
+    pol = RecoveryPolicy(epoch_batches=epoch_batches,
+                         max_restarts=n_kills + 1,
+                         restart_backoff=0.005)
+    # shards > 1 overlap ships completed launches in completion order,
+    # so the plain run's cross-key interleave is wall-clock; recovery
+    # mode pins overlap off (patterns/native_core.py) — judge the crash
+    # against an uncrashed run under the SAME policy so both sides are
+    # deterministic and the compare stays byte-exact
+    oracle = run(recovery=pol if shards > 1 else None)
+    got = run(recovery=pol, kills=kill_at)
+    assert got == oracle, (
+        f"{repro}: recovered native-core output diverged from the "
+        f"uncrashed oracle ({len(got)} vs {len(oracle)} rows; "
+        f"params {params})")
+    return params
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=100, help="number of cases")
     ap.add_argument("--seed", type=int, default=11)
     ap.add_argument("--case", type=int, default=None,
                     help="run exactly one case (repro mode)")
+    ap.add_argument("--native", action="store_true",
+                    help="soak the C++ resident core's state ABI")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
+    case_fn = run_case_native if args.native else run_case
     if args.case is not None:
-        run_case(args.seed, args.case, verbose=True)
+        case_fn(args.seed, args.case, verbose=True)
         print("OK")
         return
     for case in range(args.n):
-        run_case(args.seed, case, verbose=args.verbose)
+        case_fn(args.seed, case, verbose=args.verbose)
         if (case + 1) % 10 == 0:
             print(f"{case + 1}/{args.n} cases OK")
     print(f"all {args.n} cases OK")

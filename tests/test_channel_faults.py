@@ -164,17 +164,40 @@ def test_receiver_close_wakes_blocked_batches():
     assert isinstance(result["err"], ChannelError)
 
 
+def _blackhole_addr():
+    """((host, port), holder): an address this host cannot complete a
+    connect to.  10.255.255.1 drops SYNs silently on typical CI hosts,
+    but some container networks NAT it to a real listener — probe first,
+    and fall back to a bound-but-never-listening local socket (connects
+    get RST: the refused path, still deadline-bounded)."""
+    probe = socket.socket()
+    probe.settimeout(0.25)
+    try:
+        probe.connect(("10.255.255.1", 9))
+    except OSError:
+        # timed out (genuine blackhole) or refused fast — either way the
+        # address never yields a usable connection, so keep it
+        probe.close()
+        return ("10.255.255.1", 9), None
+    probe.close()
+    hold = socket.socket()
+    hold.bind(("127.0.0.1", 0))      # bound, no listen(): RST on connect
+    return hold.getsockname(), hold
+
+
 def test_connect_deadline_clamps_attempt_timeout():
     """The per-attempt socket timeout is clamped to the remaining
     deadline, so a blackholed host cannot overshoot the bound by a whole
     attempt (attempt timeout 30s vs deadline 0.6s)."""
-    # 10.255.255.1 is a non-routable address: SYNs are dropped silently
-    # (blackhole) on typical CI hosts; if the network answers fast with
-    # RST instead, the test still passes through the refused path
-    t0 = time.monotonic()
-    with pytest.raises((ConnectionError, OSError)):
-        RowSender("10.255.255.1", 9, timeout=30.0, connect_deadline=0.6)
-    assert time.monotonic() - t0 < 10
+    (host, port), hold = _blackhole_addr()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError)):
+            RowSender(host, port, timeout=30.0, connect_deadline=0.6)
+        assert time.monotonic() - t0 < 10
+    finally:
+        if hold is not None:
+            hold.close()
 
 
 # -------------------------------------------------------- frame protocol

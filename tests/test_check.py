@@ -80,15 +80,16 @@ def _pipe(*patterns, **kw):
 
 # ------------------------------------------------------- stub cores
 
-class _StubHostCore:
-    """Deep-copyable stand-in for a host window core."""
-    spec = WindowSpec(4, 2, WinType.CB)
-
-
 class NativeResidentCore:
-    """Stub matching the WF201 duck-type probe (class name), so the
-    corpus runs with or without the native .so."""
+    """Stub matching the WF215 duck-type probe (class name + missing
+    has_state_abi), so the corpus runs with or without the native .so.
+    The real core sets ``has_state_abi`` from the loaded library; the
+    stub's default (absent → False) models a pre-ABI .so."""
     spec = WindowSpec(4, 2, WinType.CB)
+
+    def __init__(self, abi=False):
+        if abi:
+            self.has_state_abi = True
 
 
 class _StubAsyncCore:
@@ -115,15 +116,9 @@ def _routing_df(routing):
     return df
 
 
-def _native_df():
+def _native_df(abi=False):
     df = Dataflow("nat", recovery=RecoveryPolicy())
-    df.add(WinSeqNode(NativeResidentCore(), name="agg.0"))
-    return df
-
-
-def _host_df():
-    df = Dataflow("nat", recovery=RecoveryPolicy())
-    df.add(WinSeqNode(_StubHostCore(), name="agg.0"))
+    df.add(WinSeqNode(NativeResidentCore(abi=abi), name="agg.0"))
     return df
 
 
@@ -234,7 +229,6 @@ CORPUS = {
               lambda t: _pipe(PaneFarm(_red, _red, 10, 5, WinType.CB,
                                        plq_result_fields=_win_fields(),
                                        wlq_result_fields=_win_fields()))),
-    "WF201": (lambda t: _native_df(), lambda t: _host_df()),
     "WF202": (lambda t: _async_df(0.005), lambda t: _async_df(None)),
     "WF203": (lambda t: _comb_df(async_first=True),
               lambda t: _comb_df(async_first=False)),
@@ -264,6 +258,7 @@ CORPUS = {
               lambda t: _trace_pipe(str(t))),
     "WF214": (lambda t: WireConfig(resume=True),
               lambda t: WireConfig(resume=True, recovery=True)),
+    "WF215": (lambda t: _native_df(), lambda t: _native_df(abi=True)),
     "WF301": (lambda t: _race_pipe(guarded=False),
               lambda t: _race_pipe(guarded=True)),
     "WF302": (lambda t: _global_pipe(True),
@@ -302,21 +297,37 @@ def test_minimally_fixed_twin(code, tmp_path):
 # ---------------------------------------------------------- knob tests
 
 def test_check_error_raises_before_threads():
-    """Acceptance (ISSUE 11): recovery= x native core under
-    check='error' raises BEFORE any thread starts, naming the WF id and
-    the node's canonical node_stats_name."""
-    df = _native_df()
+    """Acceptance (ISSUE 11): an error diagnostic (recovery= x
+    max_delay_ms device core) under check='error' raises BEFORE any
+    thread starts, naming the WF id and the node's canonical
+    node_stats_name."""
+    df = _async_df(0.005)
     df.check = "error"
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         with pytest.raises(CheckError) as ei:
             df.run()
     msg = str(ei.value)
-    assert "WF201" in msg
+    assert "WF202" in msg
     from windflow_tpu.utils.tracing import node_stats_name
-    assert node_stats_name("nat", 0, "agg.0") in msg
+    assert node_stats_name("dev", 0, "agg.0") in msg
     assert df._threads == []          # nothing started
     assert ei.value.report.has_errors
+
+
+def test_check_native_stale_so_is_warning():
+    """ISSUE 17: the retired WF201 error is now the WF215 warning — a
+    native core on a pre-ABI .so under recovery= warns (default paths
+    run; the first snapshot declines loudly at the barrier) instead of
+    blocking run, and a state-ABI library reports nothing."""
+    report = validate(_native_df())
+    [d] = [d for d in report if d.code == "WF215"]
+    assert d.severity == "warning"
+    assert not report.has_errors      # check='error' no longer blocks
+    from windflow_tpu.utils.tracing import node_stats_name
+    assert d.node == node_stats_name("nat", 0, "agg.0")
+    from windflow_tpu.check.diagnostics import CATALOG
+    assert "WF201" not in CATALOG     # retired, never reused
 
 
 def test_check_warn_reports_and_still_runs():

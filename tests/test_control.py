@@ -339,6 +339,59 @@ def test_threshold_driven_rescale_differential():
     assert per_key(got) == per_key(oracle)
 
 
+def test_native_keyfarm_threshold_rescale_matches_oracle():
+    """ISSUE 17 acceptance: a threshold-driven Rescale on a Key_Farm of
+    native C++ cores migrates per-key wf_core state at the epoch
+    barrier — per-key result sequences identical to the fixed-width
+    oracle (order, drops, dups checked per key)."""
+    from windflow_tpu.native import enabled
+    lib = enabled()
+    if lib is None or not getattr(lib, "wf_has_state_abi", False):
+        pytest.skip("native library with the state ABI unavailable")
+    from windflow_tpu.patterns.native_core import NativeResidentCore
+    from windflow_tpu.patterns.win_seq_tpu import KeyFarmTPU
+
+    def build(out, **kw):
+        pipe = MultiPipe("job", capacity=4, **kw)
+        pipe.add_source(Source(batches=lambda i: keyed_batches(),
+                               name="src"))
+        pipe.add(KeyFarmTPU(Reducer("sum", "value"), 8, 4, pardegree=2,
+                            batch_len=64, name="kf"))
+
+        def sink(r):
+            if r is not None:
+                time.sleep(0.0002)    # slow sink: inbox depth drives the rule
+                out.append((int(r["key"]), int(r["id"]),
+                            int(r["value"])))
+        pipe.add_sink(Sink(sink, name="sink"))
+        return pipe
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # int32-accumulation advisory
+        oracle = []
+        build(oracle).run_and_wait_end(timeout=300)
+        got = []
+        pipe = build(got, control=ControlPolicy(
+            [Rescale("kf", max_workers=4, min_workers=1, up_depth=1,
+                     down_depth=-1, hysteresis=1, cooldown=0.0)],
+            period=0.02),
+            recovery=RecoveryPolicy(epoch_batches=4,
+                                    restart_backoff=0.01),
+            metrics=True)
+        df = pipe._build()
+        workers = [n for n in df.nodes if n.name.startswith("kf.")
+                   and "emitter" not in n.name
+                   and "collector" not in n.name]
+        assert workers
+        for w in workers:
+            assert isinstance(w.core, NativeResidentCore)
+            assert w.core.has_state_abi and w.core.keyed_migratable
+        pipe.run_and_wait_end(timeout=300)
+    hist = [h for fc in pipe.controller.farms for h in fc.history]
+    assert hist, "threshold rule never fired"
+    assert per_key(got) == per_key(oracle)
+
+
 def test_crash_after_rescale_restores_migrated_placement():
     """A worker crash after a completed rescale restores the
     POST-migration snapshot (re-committed through the writer path) and

@@ -1066,3 +1066,202 @@ def test_native_abi_guards():
         assert got == -1, "single-field entry on a 2-field core must refuse"
     finally:
         lib.wf_core_free(h)
+
+
+# ------------------------------------------------- state ABI (ISSUE 17)
+
+def _abi_source_constant():
+    import os
+    import re
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "wf_native.cpp")
+    with open(src) as f:
+        m = re.search(r"kStateAbiVersion\s*=\s*(\d+)", f.read())
+    assert m, "kStateAbiVersion constant missing from wf_native.cpp"
+    return int(m.group(1))
+
+
+def test_abi_version_matches_source():
+    """The loaded .so's wf_abi_version() equals the kStateAbiVersion
+    constant in wf_native.cpp — a forgotten rebuild after an ABI bump
+    would silently import incompatible blobs otherwise."""
+    lib = native.load()
+    assert getattr(lib, "wf_has_state_abi", False), (
+        "the built library must export the state ABI")
+    assert int(lib.wf_abi_version()) == _abi_source_constant()
+
+
+def test_bind_tolerates_pre_abi_library(monkeypatch):
+    """_bind over a library missing the state symbols (a stale .so from
+    before this ABI) must succeed with wf_has_state_abi=False instead of
+    raising — default paths keep the old library serviceable."""
+    _STATE_SYMS = {
+        "wf_abi_version", "wf_core_state_size", "wf_core_state_export",
+        "wf_core_state_import", "wf_core_key_count", "wf_core_key_list",
+        "wf_core_key_state_size", "wf_core_key_export",
+        "wf_core_key_import", "wf_core_key_neutralize"}
+
+    class _Fn:
+        restype = None
+        argtypes = None
+
+    class _OldLib:
+        def __getattr__(self, name):
+            if name in _STATE_SYMS:
+                raise AttributeError(name)
+            fn = _Fn()
+            self.__dict__[name] = fn
+            return fn
+
+    # _bind assigns the module-global _lib; snapshot + restore it
+    monkeypatch.setattr(native, "_lib", native._lib)
+    lib = native._bind(_OldLib())
+    assert lib.wf_has_state_abi is False
+    assert lib.wf_has_overload_queue is True
+
+
+def _dense_stream(n_batches=12, rows=40, n_keys=5, seed=3):
+    """Per-key dense ids / monotone ts (the pristine-source contract)."""
+    rng = np.random.default_rng(seed)
+    ctr = {}
+    out = []
+    for _ in range(n_batches):
+        b = np.zeros(rows, dtype=SCHEMA.dtype())
+        keys = rng.integers(0, n_keys, rows)
+        b["key"] = keys
+        b["value"] = rng.integers(-50, 100, rows)
+        for i, k in enumerate(keys.tolist()):
+            b["id"][i] = ctr.get(k, 0)
+            ctr[k] = ctr.get(k, 0) + 1
+        b["ts"] = b["id"]
+        out.append(b)
+    return out
+
+
+@pytest.mark.parametrize("shards", [1, 2, 3])
+def test_native_state_roundtrip_byte_identical(shards):
+    """Crash differential at the core level: run A drains + snapshots at
+    a barrier and continues; run B snapshots the same barrier, then a
+    FRESH core restores the blob and replays the tail.  Emission streams
+    must be byte-identical, batch boundaries included."""
+    spec = WindowSpec(8, 4, WinType.CB)
+    batches = _dense_stream()
+    cut = 6
+
+    def fresh():
+        return make_native(spec, Reducer("sum", "value"), batch_len=32,
+                           flush_rows=64, shards=shards,
+                           overlap=(shards > 1))
+
+    def run(core, bs):
+        out = []
+        for b in bs:
+            out.extend(core.process_batches(b))
+        return out
+
+    a = fresh()
+    out_a = run(a, batches[:cut])
+    out_a.extend(a.checkpoint_drain_batches())
+    a.state_snapshot()
+    out_a.extend(run(a, batches[cut:]))
+    out_a.extend(a.flush_batches())
+
+    b = fresh()
+    out_b = run(b, batches[:cut])
+    out_b.extend(b.checkpoint_drain_batches())
+    snap = b.state_snapshot()
+    r = fresh()                      # the restarted worker
+    r.state_restore(snap)
+    out_b.extend(run(r, batches[cut:]))
+    out_b.extend(r.flush_batches())
+
+    assert [x.tobytes() for x in out_a] == [x.tobytes() for x in out_b]
+
+
+def test_native_state_export_requires_drain():
+    """wf_core_state_export refuses an undrained core: pending rows not
+    yet flushed to launches would be silently dropped by the blob."""
+    core = make_native(WindowSpec(8, 4, WinType.CB),
+                       Reducer("sum", "value"), batch_len=32,
+                       flush_rows=1 << 20)
+    core.process(_dense_stream(n_batches=1)[0])
+    with pytest.raises(RuntimeError, match="not drained"):
+        core.state_snapshot()
+    core.checkpoint_drain_batches()
+    core.state_snapshot()            # drained now: export succeeds
+
+
+def _per_key(rows):
+    d = {}
+    for r in rows:
+        d.setdefault(int(r["key"]), []).append(
+            (int(r["id"]), int(r["value"])))
+    return d
+
+
+def test_native_keyed_migration_per_key_equal():
+    """Key_Farm migration at a barrier: export+neutralize moving keys on
+    the old owner, import on the new owner, feed the tail to the new
+    owner — merged per-key result sequences equal the single-core
+    oracle's."""
+    spec = WindowSpec(8, 4, WinType.CB)
+    batches = _dense_stream(n_keys=4)
+    cut = 6
+    reducer = Reducer("sum", "value")
+
+    oracle = make_native(spec, reducer, batch_len=32, flush_rows=64)
+    want = []
+    for b in batches:
+        want.extend(oracle.process_batches(b))
+    want.extend(oracle.flush_batches())
+    want = _per_key(np.concatenate([x for x in want if len(x)]))
+
+    w0 = make_native(spec, reducer, batch_len=32, flush_rows=64)
+    w1 = make_native(spec, reducer, batch_len=32, flush_rows=64)
+    owner = {0: w0, 1: w0, 2: w1, 3: w1}   # pre-cut routing
+    got = []
+
+    def feed(b):
+        for w in (w0, w1):
+            mask = np.isin(b["key"], [k for k, o in owner.items()
+                                      if o is w])
+            got.extend(w.process_batches(b[mask]))
+
+    for b in batches[:cut]:
+        feed(b)
+    # the barrier: both drained, keys 0/1 migrate w0 -> w1
+    got.extend(w0.checkpoint_drain_batches())
+    got.extend(w1.checkpoint_drain_batches())
+    assert sorted(w0.keyed_state_keys()) == [0, 1]
+    frag = w0.keyed_state_export([0, 1])
+    assert frag["kind"] == "native_keys"
+    w1.keyed_state_import(frag)
+    assert list(w0.keyed_state_keys()) == []   # neutralized on export
+    owner[0] = owner[1] = w1
+    for b in batches[cut:]:
+        feed(b)
+    got.extend(w0.flush_batches())
+    got.extend(w1.flush_batches())
+    got = _per_key(np.concatenate([x for x in got if len(x)]))
+    assert got == want
+
+
+def test_native_stale_so_core_declines_loudly():
+    """A core bound against a pre-ABI library (simulated by the flags
+    _bind would have left) declines snapshots and migration with
+    SnapshotUnsupported while default execution is unchanged."""
+    from windflow_tpu.runtime.node import SnapshotUnsupported
+    spec = WindowSpec(8, 4, WinType.CB)
+    batches = _dense_stream()
+    core = make_native(spec, Reducer("sum", "value"), batch_len=32,
+                       flush_rows=64)
+    core.has_state_abi = False
+    core.keyed_migratable = False
+    for what in (core.state_snapshot, core.keyed_state_keys,
+                 lambda: core.keyed_state_export([0]),
+                 lambda: core.keyed_state_import({"kind": "native_keys"}),
+                 lambda: core.state_restore({"kind": "native"})):
+        with pytest.raises(SnapshotUnsupported, match="state ABI"):
+            what()
+    host = run_core(WinSeqCore(spec, Reducer("sum", "value")), batches)
+    assert_equal_results(host, run_core(core, batches))

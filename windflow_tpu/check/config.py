@@ -49,6 +49,15 @@ def _obs_configured(metrics, sample_period) -> bool:
     return bool(metrics) or sample_period is not None
 
 
+def _native_state_abi() -> bool:
+    """True when device-farm workers would route to the native C++ core
+    AND that core can migrate keyed state (the loaded .so exports the
+    state ABI)."""
+    from ..native import enabled
+    lib = enabled()
+    return lib is not None and getattr(lib, "wf_has_state_abi", False)
+
+
 def _iter_pipe_patterns(pipe):
     for branch in pipe._branches:
         yield from _iter_pipe_patterns(branch)
@@ -128,11 +137,17 @@ def check_pipe_control(pipe) -> list[Diagnostic]:
                 f"ordered multi-emitter merges pin the channel count "
                 f"at build time and cannot rescale (docs/CONTROL.md)",
                 node=name, anchor=anchor))
-        elif type(pattern).__name__.endswith("TPU"):
-            # duck-typed like the WF201 native-core probe: device farm
-            # workers mirror per-key rows into HBM rings / native
-            # tables the host migration hooks cannot move, so their
-            # cores set keyed_migratable=False and attach refuses
+        elif (type(pattern).__name__.endswith("TPU")
+                and not _native_state_abi()):
+            # duck-typed like the WF215 native-core probe: device farm
+            # workers mirror per-key rows into HBM rings the host
+            # migration hooks cannot move, so their cores set
+            # keyed_migratable=False and attach refuses.  When the
+            # native library exports the state ABI the farm's workers
+            # route to the migratable C++ core instead, so stay quiet
+            # and let attach-time validation judge the actual cores
+            # (a float reducer still lands on a device core and is
+            # refused there with the precise ValueError).
             diags.append(Diagnostic(
                 "WF210",
                 f"Rescale rule targets device farm {name!r} "

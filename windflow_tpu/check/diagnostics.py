@@ -31,10 +31,9 @@ CATALOG: dict[str, tuple[str, str]] = {
               "pane factor does not divide the window: pane "
               "decomposition degenerates to gcd-sized panes"),
     # -- WF2xx: configuration conflicts ---------------------------------
-    "WF201": (ERROR,
-              "recovery= over the native C++ resident core: snapshots "
-              "unsupported, first checkpoint dies with "
-              "SnapshotUnsupported"),
+    # WF201 retired (id never reused): the native core gained a state
+    # ABI, so recovery= over it is supported whenever the loaded .so
+    # exports the state symbols — WF215 warns on the stale-.so case.
     "WF202": (ERROR,
               "recovery= over a max_delay_ms device core: wall-clock "
               "flushes make replay emission boundaries nondeterministic"),
@@ -83,6 +82,11 @@ CATALOG: dict[str, tuple[str, str]] = {
               "WireConfig resume= without recovery=: no sealed-epoch "
               "acks flow back, so the sender journal can never trim and "
               "fills to its cap"),
+    "WF215": (WARNING,
+              "recovery=/Rescale over a native core whose loaded .so "
+              "lacks the state ABI: default execution runs, but the "
+              "first snapshot or migration declines with "
+              "SnapshotUnsupported"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "

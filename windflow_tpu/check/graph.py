@@ -70,9 +70,10 @@ def _anchor_of(pattern):
 # --------------------------------------------------------------- passes
 
 def _check_recovery(df) -> list[Diagnostic]:
-    """WF201-204: recovery= over nodes whose configuration declines
-    snapshots or restart — today these die at the FIRST checkpoint
-    (SnapshotUnsupported) or silently degrade to fail-like-seed."""
+    """WF202-204 + WF215: recovery= over nodes whose configuration
+    declines snapshots or restart — today these die at the FIRST
+    checkpoint (SnapshotUnsupported) or silently degrade to
+    fail-like-seed."""
     diags = []
     if df.recovery is None:
         return diags
@@ -84,15 +85,17 @@ def _check_recovery(df) -> list[Diagnostic]:
             core = _core_of(leaf)
             if core is None:
                 continue
-            if type(core).__name__ == "NativeResidentCore":
+            if (type(core).__name__ == "NativeResidentCore"
+                    and not getattr(core, "has_state_abi", False)):
                 diags.append(Diagnostic(
-                    "WF201",
+                    "WF215",
                     f"recovery= over the native C++ resident core at "
-                    f"{name}: state lives in native wf_core tables with "
-                    f"no snapshot API — the first epoch checkpoint "
-                    f"raises SnapshotUnsupported "
-                    f"(patterns/native_core.py); set WF_NO_NATIVE_CORE=1 "
-                    f"to pin the snapshotable Python resident core",
+                    f"{name}, but the loaded libwfnative.so predates "
+                    f"the state ABI (no wf_core_state_export) — the "
+                    f"first epoch checkpoint raises SnapshotUnsupported "
+                    f"(patterns/native_core.py); rebuild with `make -C "
+                    f"native`, or set WF_NO_NATIVE_CORE=1 to pin the "
+                    f"snapshotable Python resident core",
                     node=name))
             elif (_is_async_core(core)
                     and getattr(core, "max_delay_s", None) is not None):

@@ -101,8 +101,9 @@ class FarmController:
         """Pre-run checks (Controller.attach): every worker must be
         supervised + journaling (the barrier protocol rides the
         recovery machinery) and its core must export/import per-key
-        state (host window cores and keyed accumulators; device and
-        native cores decline — docs/CONTROL.md)."""
+        state (host window cores, keyed accumulators, and native cores
+        with the state ABI; device cores and native cores on a
+        pre-ABI .so decline — docs/CONTROL.md)."""
         name = self.pattern.name
         if self.emitter._recov is None:
             raise ValueError(f"Rescale {name!r}: the farm emitter is not "
@@ -118,9 +119,10 @@ class FarmController:
                 raise ValueError(
                     f"Rescale {name!r}: worker {w.name!r} "
                     f"({type(getattr(w, 'core', w)).__name__}) has no "
-                    f"keyed-state migration hooks — host window cores "
-                    f"and keyed accumulators rescale; device/native "
-                    f"cores decline (docs/CONTROL.md)")
+                    f"keyed-state migration hooks — host window cores, "
+                    f"keyed accumulators, and native cores with the "
+                    f"state ABI rescale; device cores and pre-ABI "
+                    f"native libraries decline (docs/CONTROL.md)")
 
     def install_hooks(self):
         # the ANNOUNCE runs before the emitter's marker leaves (engine

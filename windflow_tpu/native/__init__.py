@@ -154,6 +154,39 @@ def _bind(lib):
         lib.wf_has_overload_queue = True
     except AttributeError:
         lib.wf_has_overload_queue = False
+    # state-ABI entry points (checkpoints + keyed live rescale for the
+    # native core, docs/ROBUSTNESS.md "Native state ABI") — absent from a
+    # pre-ABI .so; bind tolerantly so an old library still serves every
+    # default execution path while snapshot/migration requests decline
+    # loudly (SnapshotUnsupported / check WF215 gate on this flag)
+    try:
+        lib.wf_abi_version.restype = i64
+        lib.wf_abi_version.argtypes = []
+        lib.wf_core_state_size.restype = i64
+        lib.wf_core_state_size.argtypes = [ctypes.c_void_p]
+        lib.wf_core_state_export.restype = i64
+        lib.wf_core_state_export.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_void_p, i64]
+        lib.wf_core_state_import.restype = i64
+        lib.wf_core_state_import.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_void_p, i64]
+        lib.wf_core_key_count.restype = i64
+        lib.wf_core_key_count.argtypes = [ctypes.c_void_p]
+        lib.wf_core_key_list.restype = i64
+        lib.wf_core_key_list.argtypes = [ctypes.c_void_p, p_i64, i64]
+        lib.wf_core_key_state_size.restype = i64
+        lib.wf_core_key_state_size.argtypes = [ctypes.c_void_p, i64]
+        lib.wf_core_key_export.restype = i64
+        lib.wf_core_key_export.argtypes = [ctypes.c_void_p, i64,
+                                           ctypes.c_void_p, i64]
+        lib.wf_core_key_import.restype = i64
+        lib.wf_core_key_import.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_void_p, i64]
+        lib.wf_core_key_neutralize.restype = i64
+        lib.wf_core_key_neutralize.argtypes = [ctypes.c_void_p, i64]
+        lib.wf_has_state_abi = True
+    except AttributeError:
+        lib.wf_has_state_abi = False
     _lib = lib
     return _lib
 

@@ -54,6 +54,39 @@ def _pick_flush_mult(svc_ms) -> int:
     return mult
 
 
+_U64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64, bit-identical to wf_native.cpp's mix64 — the key→shard
+    hash, needed host-side to route a migrated key's blob to the shard
+    sub-core that will process its future rows."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _U64
+    return x ^ (x >> 31)
+
+
+class NativeStateSnapshot:
+    """Checkpoint handle over the native core's exported state blobs
+    (recovery layer, docs/ROBUSTNESS.md "Native state ABI").
+
+    Unlike the resident ring's RingSnapshot, the C++ tables are MUTABLE —
+    the byte copy must happen at the barrier (wf_core_state_export runs on
+    the node thread, under the drained cut) — so resolve(), on the
+    supervisor's writer thread, only packages the already-captured bytes
+    into the pickle-ready dict."""
+
+    __slots__ = ("blobs", "abi")
+
+    def __init__(self, blobs, abi: int):
+        self.blobs = tuple(blobs)   # one bytes blob per key shard
+        self.abi = int(abi)
+
+    def resolve(self) -> dict:
+        return {"kind": "native", "abi": self.abi, "blobs": self.blobs}
+
+
 def _ship_loop(core_ref, ship_q, shard):
     """Ship-thread main: one thread per key shard, so the shards'
     device_put / dispatch / harvest overlap on the wire (a single thread
@@ -223,31 +256,27 @@ class NativeResidentCore:
                     depth=depth, acc_dtype=acc)
                 for t in range(self.shards)]
         self.executor = self.executors[0]
-        cfg = self.config
-        self._hs = [self._lib.wf_core_new(
-            int(spec.win_len), int(spec.slide_len),
-            0 if spec.win_type is WinType.CB else 1, _ROLE_CODE[role],
-            int(cfg.id_outer), int(cfg.n_outer), int(cfg.slide_outer),
-            int(cfg.id_inner), int(cfg.n_inner), int(cfg.slide_inner),
-            int(map_indexes[0]), int(map_indexes[1]),
-            int(self.result_ts_slide), int(batch_len), int(flush_rows),
-            3 if acc.itemsize >= 8 else 2) for _ in range(self.shards)]
-        if self._multi:
-            # per-field widest wire dtype (ship_fields order): the C++
-            # flush narrows each column independently against its ring
-            mw = (ctypes.c_int * len(self._ship_fields))(*[
-                3 if self._acc_by_field[f].itemsize >= 8 else 2
-                for f in self._ship_fields])
-            for h in self._hs:
-                got = self._lib.wf_core_set_fields(
-                    h, len(self._ship_fields), mw)
-                if got != len(self._ship_fields):
-                    # a short accept would leave the missing columns'
-                    # rectangles uninitialized at take time — refuse
-                    raise TypeError(
-                        f"native core accepted {got} fields, "
-                        f"need {len(self._ship_fields)}")
-        self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
+        self._batch_len = int(batch_len)
+        self._acc_wire = 3 if acc.itemsize >= 8 else 2
+        self._flush_base = int(flush_rows)
+        self._flush_mult = 1
+        self._new_handles()
+        #: recovery/rescale support requires the state-ABI symbols in the
+        #: loaded .so (stale-library detection: snapshots decline loudly,
+        #: check/graph.py's WF215 warns, rescale validate() refuses)
+        self.has_state_abi = bool(getattr(self._lib, "wf_has_state_abi",
+                                          False))
+        #: control-plane keyed migration (control/rescale.py) — an
+        #: instance attr, not a class attr: it follows the loaded library
+        self.keyed_migratable = self.has_state_abi
+        #: dataflow metrics sink, mirrored by Supervisor.attach_all (the
+        #: core itself has no dataflow reference)
+        self._obs_metrics = None
+        #: recovery-mode latch (process_batches and friends): pins
+        #: deterministic launch boundaries — no reactive coalescing, no
+        #: proactive flush resizing — so a replayed run's per-launch
+        #: emission regroups exactly like the original's
+        self._recovery_mode = False
         # proactive dispatch sizing: seed the natural flush size from the
         # process-global wire weather (a warmup run's harvests populate
         # it), then retune per chunk from this core's own measured
@@ -255,8 +284,6 @@ class NativeResidentCore:
         # growing flushes there would spend the max_delay budget on
         # purpose-built queueing.
         from ..ops import resident as _res
-        self._flush_base = int(flush_rows)
-        self._flush_mult = 1
         # proactive sizing is OPT-IN (WF_PROACTIVE=1): the interleaved A/B
         # of 2026-07-31 (scripts/ab_proactive.py, BASELINE.md) measured it
         # LOSING to reactive coalescing — mult-8 naturals drove per-
@@ -309,21 +336,58 @@ class NativeResidentCore:
         #: 2^19-row flush = 2^23 cells)
         self._coalesce_cells = (1 << 24) // max(len(self._ship_fields), 1)
         if self._overlap:
-            self._out_q = _queue.SimpleQueue()
-            # one ship thread per shard: each owns its executor, so the
-            # shards' wire traffic overlaps; threads hold only a weakref
-            # (a live ship thread must not keep the core and its C++ heap
-            # + device rings alive)
-            self._ship_qs = [_queue.SimpleQueue()
-                             for _ in range(self.shards)]
-            self._ship_threads = [
-                threading.Thread(
-                    target=_ship_loop,
-                    args=(weakref.ref(self), self._ship_qs[t], t),
-                    daemon=True, name=f"wf-ship.{t}")
-                for t in range(self.shards)]
-            for th in self._ship_threads:
-                th.start()
+            self._start_ship_threads()
+
+    def _new_handles(self):
+        """(Re)create the per-shard C++ cores with the constructor's
+        config — shared by __init__ and state_restore (restore imports
+        into FRESH handles rather than scrubbing live ones)."""
+        spec, cfg = self.spec, self.config
+        self._hs = [self._lib.wf_core_new(
+            int(spec.win_len), int(spec.slide_len),
+            0 if spec.win_type is WinType.CB else 1, _ROLE_CODE[self.role],
+            int(cfg.id_outer), int(cfg.n_outer), int(cfg.slide_outer),
+            int(cfg.id_inner), int(cfg.n_inner), int(cfg.slide_inner),
+            int(self.map_indexes[0]), int(self.map_indexes[1]),
+            int(self.result_ts_slide), self._batch_len, self._flush_base,
+            self._acc_wire) for _ in range(self.shards)]
+        if self._multi:
+            # per-field widest wire dtype (ship_fields order): the C++
+            # flush narrows each column independently against its ring
+            mw = (ctypes.c_int * len(self._ship_fields))(*[
+                3 if self._acc_by_field[f].itemsize >= 8 else 2
+                for f in self._ship_fields])
+            for h in self._hs:
+                got = self._lib.wf_core_set_fields(
+                    h, len(self._ship_fields), mw)
+                if got != len(self._ship_fields):
+                    # a short accept would leave the missing columns'
+                    # rectangles uninitialized at take time — refuse
+                    raise TypeError(
+                        f"native core accepted {got} fields, "
+                        f"need {len(self._ship_fields)}")
+        if self._flush_mult > 1:
+            for h in self._hs:
+                self._lib.wf_core_set_flush_rows(
+                    h, self._flush_base * self._flush_mult)
+        self._harr = (ctypes.c_void_p * self.shards)(*self._hs)
+
+    def _start_ship_threads(self):
+        # one ship thread per shard: each owns its executor, so the
+        # shards' wire traffic overlaps; threads hold only a weakref
+        # (a live ship thread must not keep the core and its C++ heap
+        # + device rings alive)
+        self._out_q = _queue.SimpleQueue()
+        self._ship_qs = [_queue.SimpleQueue()
+                         for _ in range(self.shards)]
+        self._ship_threads = [
+            threading.Thread(
+                target=_ship_loop,
+                args=(weakref.ref(self), self._ship_qs[t], t),
+                daemon=True, name=f"wf-ship.{t}")
+            for t in range(self.shards)]
+        for th in self._ship_threads:
+            th.start()
 
     def _stop_worker(self):
         for t, th in enumerate(getattr(self, "_ship_threads", ()) or ()):
@@ -405,37 +469,316 @@ class NativeResidentCore:
 
     # ------------------------------------------------------------ streaming
 
-    # -- recovery (docs/ROBUSTNESS.md) ------------------------------------
+    # -- recovery (docs/ROBUSTNESS.md "Native state ABI") ------------------
+
+    def _obs_count(self, name, n=1):
+        m = self._obs_metrics
+        if m is not None:
+            m.counter(name).inc(n)
+
+    def _obs_hist(self, name, v):
+        m = self._obs_metrics
+        if m is not None:
+            m.histogram(name).observe(v)
+
+    def _require_state_abi(self, what: str):
+        """Loud decline when the loaded .so predates the state ABI — the
+        same degradation as before the ABI existed (check WF215 warns at
+        build time about exactly this)."""
+        if not self.has_state_abi:
+            from ..runtime.node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                f"the loaded native library lacks the state ABI "
+                f"(wf_core_state_export): {what} unsupported — rebuild "
+                f"native/libwfnative.so (make -C native) or set "
+                f"WF_NO_NATIVE_CORE=1 to run the Python resident core")
+
+    def _enter_recovery_mode(self):
+        """Pin deterministic launch boundaries for recovery-mode runs:
+        reactive coalescing fuses queued launches by measured wire
+        service and proactive sizing rescales flush_rows by wire weather
+        — both wall-clock-driven, so a replayed run's launch boundaries
+        (and with them the per-launch emission seqs) would diverge from
+        the original's.  Natural flushes alone are count-triggered."""
+        if self._recovery_mode:
+            return
+        self._recovery_mode = True
+        self._proactive = False
+        if self._flush_mult > 1:
+            self._flush_mult = 1
+            for h in self._hs:
+                self._lib.wf_core_set_flush_rows(h, self._flush_base)
+        if self._overlap:
+            # ship threads drain into ONE completion-ordered queue, so a
+            # multi-shard core's emission interleaving is wall-clock —
+            # recovery runs ship synchronously in shard-major order
+            # instead (deterministic, at the cost of the wire overlap)
+            self._stop_worker()
+            self._salvaged.extend(self._drain_out_q())
+            self._overlap = False
+
+    def _drain_entries(self):
+        """Ship every queued launch and block out in-flight results;
+        returns the raw per-launch harvest entries."""
+        if self._overlap:
+            evs = [threading.Event() for _ in self._ship_qs]
+            for q, ev in zip(self._ship_qs, evs):
+                q.put(("drain", ev))
+            for ev in evs:
+                ev.wait()
+            drained = self._drain_out_q()
+            if self._ship_exc is not None:
+                self._raise_ship_exc(drained)
+            out, self._salvaged = self._salvaged + drained, []
+            return out
+        harvested = []
+        for t in range(self.shards):
+            while self._ship_launch(t, force=True):
+                pass
+            harvested.extend(self.executors[t].drain())
+        return harvested
+
+    def process_batches(self, batch):
+        """Recovery-mode process(): same work, ONE output batch per
+        completed launch, in launch order (the _AsyncLaunchRecovery
+        contract, win_seq_tpu.py).  Unlike the single-executor resident
+        core, the sharded native core has one launch FIFO per shard with
+        wall-clock completion interleaving — so recovery mode drains all
+        shards each call and emits entries in shard-major order, trading
+        the wire/compute overlap for deterministic emission boundaries."""
+        if self._delegate is not None:
+            return self._delegate.process_batches(batch)
+        self._enter_recovery_mode()
+        if len(batch) and self._field_offsets(batch) is None:
+            return self._fall_back().process_batches(batch)
+        self._process_rows(batch)
+        return [self._harvest([e]) for e in self._drain_entries()]
+
+    def flush_batches(self):
+        if self._delegate is not None:
+            return self._delegate.flush_batches()
+        self._enter_recovery_mode()
+        return [self._harvest([e]) for e in self._eos_and_drain()]
+
+    def checkpoint_drain_batches(self):
+        """Epoch-barrier drain (WinSeqNode.checkpoint_prepare): force-
+        flush pending rows/windows into launches — NOT eos, unfired
+        windows stay pending — and block out the in-flight results (they
+        pre-date the snapshot cut and would otherwise be lost on
+        restore).  Afterwards the C++ cores are drained, which is exactly
+        the precondition wf_core_state_export checks."""
+        if self._delegate is not None:
+            return self._delegate.checkpoint_drain_batches()
+        self._enter_recovery_mode()
+        for h in self._hs:
+            self._lib.wf_core_force_flush(h)
+        return [self._harvest([e]) for e in self._drain_entries()]
 
     def state_snapshot(self):
-        """The C++ core's per-key archives and window bookkeeping live in
-        native wf_core tables with no extraction API (yet): epoch
-        snapshots are unsupported here.  Pin WF_NO_NATIVE_CORE=1 to route
-        device aggregates onto the Python resident core, whose state
-        (host archives + HBM ring handle) snapshots and restores."""
-        from ..runtime.node import SnapshotUnsupported
-        raise SnapshotUnsupported(
-            "NativeResidentCore state lives in native tables "
-            "(wf_core_new) with no snapshot API; set WF_NO_NATIVE_CORE=1 "
-            "to run recoverable device cores")
+        """Export the drained C++ state (per-key archives + window/
+        ordering counters) into per-shard blobs.  Must run at a barrier
+        after checkpoint_drain_batches — an undrained core refuses.
+        Device ring contents never cross: restore zeroes the ring
+        geometry and the next flush rebases from the imported archives,
+        the native analog of the resident core's no-ring-snapshot path."""
+        if self._delegate is not None:
+            return {"kind": "native_delegate",
+                    "inner": self._delegate.state_snapshot()}
+        self._require_state_abi("epoch snapshots")
+        if self.max_delay_s is not None:
+            # wall-clock flushes make replay launch boundaries (and so
+            # emission seqs) nondeterministic — same decline as the
+            # Python resident core's
+            from ..runtime.node import SnapshotUnsupported
+            raise SnapshotUnsupported(
+                "max_delay_ms wall-clock flushes make replay emission "
+                "boundaries nondeterministic; recovery supports "
+                "count-triggered flushes only")
+        lib = self._lib
+        blobs = []
+        for h in self._hs:
+            n = int(lib.wf_core_state_size(h))
+            if n < 0:
+                raise RuntimeError(
+                    "native core not drained at the snapshot barrier "
+                    "(checkpoint_prepare must flush + drain first)")
+            buf = np.empty(max(n, 1), dtype=np.uint8)
+            got = int(lib.wf_core_state_export(h, buf.ctypes.data, n))
+            if got != n:
+                raise RuntimeError(
+                    f"native state export wrote {got} of {n} bytes")
+            blobs.append(buf[:n].tobytes())
+        nbytes = sum(len(b) for b in blobs)
+        self._obs_count("native_state_exports")
+        self._obs_count("native_state_export_bytes", nbytes)
+        self._obs_hist("native_state_blob_bytes", nbytes)
+        return NativeStateSnapshot(blobs, abi=int(lib.wf_abi_version()))
 
     def state_restore(self, snap):
-        raise RuntimeError("NativeResidentCore cannot restore snapshots")
+        if isinstance(snap, NativeStateSnapshot):
+            snap = snap.resolve()
+        kind = snap.get("kind")
+        if kind == "native_delegate":
+            if self._delegate is None:
+                self._fall_back()
+            self._delegate.state_restore(snap["inner"])
+            return
+        if kind != "native":
+            raise RuntimeError(
+                f"NativeResidentCore cannot restore snapshot kind {kind!r}")
+        self._require_state_abi("state restore")
+        blobs = snap["blobs"]
+        if len(blobs) != self.shards:
+            raise RuntimeError(
+                f"snapshot has {len(blobs)} shard blobs, core has "
+                f"{self.shards} shards")
+        # ship threads reach the C++ handles through queued tokens: join
+        # them BEFORE freeing (use-after-free otherwise), rebuild after
+        if self._overlap:
+            self._stop_worker()
+        for h in self._hs:
+            self._lib.wf_core_free(h)
+        self._hs = []
+        self._new_handles()
+        nbytes = 0
+        for h, blob in zip(self._hs, blobs):
+            buf = np.frombuffer(blob, dtype=np.uint8)
+            rc = int(self._lib.wf_core_state_import(
+                h, buf.ctypes.data, len(blob)))
+            if rc != 0:
+                raise RuntimeError(
+                    f"native state import failed (code {rc})")
+            nbytes += len(blob)
+        # executors: drop in-flight work and rings from the crashed run;
+        # the imported cores rebase at their next flush, re-shipping
+        # every live row
+        for ex in self.executors:
+            inv = getattr(ex, "invalidate", None)
+            if inv is not None:
+                inv()
+            else:
+                ex._inflight.clear()
+                ex._ready = []
+        self._salvaged = []
+        self._ship_exc = None
+        self._last_flush_t = None
+        if self._overlap:
+            self._start_ship_threads()
+        self._obs_count("native_state_imports")
+        self._obs_count("native_state_import_bytes", nbytes)
+
+    # -- control-plane keyed migration (control/rescale.py) ---------------
+
+    def _shard_of(self, key: int) -> int:
+        return int(_mix64(key & _U64) % self.shards) if self.shards > 1 \
+            else 0
+
+    def keyed_state_keys(self):
+        """Keys with live native state, across all shards (sorted for a
+        deterministic migration selection)."""
+        self._require_state_abi("keyed-state migration")
+        if self._delegate is not None:
+            raise RuntimeError(
+                "native core fell back to the Python delegate mid-stream; "
+                "keyed migration state is no longer in the C++ tables")
+        from ..native import p_i64
+        lib = self._lib
+        parts = []
+        for h in self._hs:
+            n = int(lib.wf_core_key_count(h))
+            if n == 0:
+                continue
+            arr = np.empty(n, dtype=np.int64)
+            got = int(lib.wf_core_key_list(
+                h, arr.ctypes.data_as(p_i64), n))
+            parts.append(arr[:min(got, n)])
+        if not parts:
+            return np.zeros(0, dtype=np.int64)
+        return np.sort(np.concatenate(parts))
+
+    def keyed_state_export(self, keys):
+        """Export-and-neutralize the given keys (move semantics, like
+        WinSeqCore's pop): the old owner never emits their windows again;
+        the blobs re-import on the new owner inside the same barrier."""
+        self._require_state_abi("keyed-state migration")
+        lib = self._lib
+        blobs = {}
+        for k in np.asarray(keys, dtype=np.int64).tolist():
+            k = int(k)
+            for h in self._hs:
+                n = int(lib.wf_core_key_state_size(h, k))
+                if n != -2:     # -2 = key not on this shard
+                    break
+            if n < 0:
+                raise RuntimeError(
+                    f"native keyed export refused for key {k} "
+                    f"(code {n}: core not drained or key unknown)")
+            buf = np.empty(max(n, 1), dtype=np.uint8)
+            got = int(lib.wf_core_key_export(h, k, buf.ctypes.data, n))
+            if got != n:
+                raise RuntimeError(
+                    f"native keyed export wrote {got} of {n} bytes "
+                    f"for key {k}")
+            rc = int(lib.wf_core_key_neutralize(h, k))
+            if rc != 0:
+                raise RuntimeError(
+                    f"native key neutralize failed for key {k} "
+                    f"(code {rc})")
+            blobs[k] = buf[:n].tobytes()
+        nbytes = sum(len(b) for b in blobs.values())
+        self._obs_count("native_state_exports")
+        self._obs_count("native_state_export_bytes", nbytes)
+        self._obs_hist("native_state_blob_bytes", nbytes)
+        return {"kind": "native_keys",
+                "abi": int(lib.wf_abi_version()), "blobs": blobs}
+
+    def keyed_state_import(self, frag):
+        self._require_state_abi("keyed-state migration")
+        kind = frag.get("kind")
+        if kind != "native_keys":
+            raise TypeError(
+                f"native core cannot import fragment kind {kind!r}")
+        lib = self._lib
+        nbytes = 0
+        for k, blob in frag["blobs"].items():
+            buf = np.frombuffer(blob, dtype=np.uint8)
+            rc = int(lib.wf_core_key_import(
+                self._hs[self._shard_of(int(k))],
+                buf.ctypes.data, len(blob)))
+            if rc != 0:
+                raise RuntimeError(
+                    f"native keyed import failed for key {k} (code {rc})")
+            nbytes += len(blob)
+        self._obs_count("native_state_imports")
+        self._obs_count("native_state_import_bytes", nbytes)
 
     def process(self, batch: np.ndarray) -> np.ndarray:
         if self._delegate is not None:
             return self._delegate.process(batch)
-        if len(batch) == 0:
-            # keepalive: an empty chunk still advances the max-delay timer
-            # (and harvests), so a thinning stream meets its latency bound
-            if self.max_delay_s is None:
-                return np.zeros(0, dtype=self._result_dtype)
-            b = None
-        else:
-            off = self._field_offsets(batch)
-            if off is None:
-                return self._fall_back().process(batch)
-            b = np.ascontiguousarray(batch)
+        if len(batch) == 0 and self.max_delay_s is None:
+            # keepalive harvesting only matters under a latency bound
+            return np.zeros(0, dtype=self._result_dtype)
+        if len(batch) and self._field_offsets(batch) is None:
+            return self._fall_back().process(batch)
+        self._process_rows(batch)
+        if self._overlap:
+            drained = self._drain_out_q()
+            if self._ship_exc is not None:
+                self._raise_ship_exc(drained)
+            out, self._salvaged = self._salvaged + drained, []
+            return self._harvest(out)
+        harvested = []
+        for t in range(self.shards):
+            while self._ship_launch(t):
+                pass
+            harvested.extend(self.executors[t].poll())
+        return self._harvest(harvested)
+
+    def _process_rows(self, batch):
+        """Feed one chunk through the C++ bookkeeping (flush cadence,
+        proactive sizing, ship-thread pokes + backpressure included);
+        harvest collection is the caller's (process vs process_batches)."""
+        b = np.ascontiguousarray(batch) if len(batch) else None
         launched = 0
         if b is not None:
             itemsize, o_key, o_id, o_ts, o_mk, o_val = self._offsets
@@ -505,21 +848,11 @@ class NativeResidentCore:
                     if beats % 20 == 0:
                         for q in self._ship_qs:
                             q.put(("ship", None))
-            drained = self._drain_out_q()
-            if self._ship_exc is not None:
-                self._raise_ship_exc(drained)
-            out, self._salvaged = self._salvaged + drained, []
-            return self._harvest(out)
-        harvested = []
-        for t in range(self.shards):
-            while self._ship_launch(t):
-                pass
-            harvested.extend(self.executors[t].poll())
-        return self._harvest(harvested)
 
-    def flush(self) -> np.ndarray:
-        if self._delegate is not None:
-            return self._delegate.flush()
+    def _eos_and_drain(self):
+        """EOS every shard core, then ship + drain everything; returns
+        the raw per-launch harvest entries (flush/flush_batches share
+        this tail)."""
         from ..ops.resident import stats_add, stats_max
         t_eos = time.monotonic()
         backlog = 0
@@ -527,30 +860,18 @@ class NativeResidentCore:
             self._lib.wf_core_eos(h)
             backlog += self._lib.wf_launch_pending(h)
         backlog += sum(len(ex._inflight) for ex in self.executors)
-        if self._overlap:
-            evs = [threading.Event() for _ in self._ship_qs]
-            for q, ev in zip(self._ship_qs, evs):
-                q.put(("drain", ev))
-            for ev in evs:
-                ev.wait()
-            drained = self._drain_out_q()
-            if self._ship_exc is not None:
-                self._raise_ship_exc(drained)
-            out, self._salvaged = self._salvaged + drained, []
-            # EOS drain accounting (VERDICT r4 #3): how long the finite-
-            # run tail waits on the wire and how deep the backlog was —
-            # the end-to-end-vs-ingest gap is exactly this number
-            stats_add("drain_ms", 1e3 * (time.monotonic() - t_eos))
-            stats_max("drain_backlog_max", backlog)
-            return self._harvest(out)
-        harvested = []
-        for t in range(self.shards):
-            while self._ship_launch(t, force=True):
-                pass
-            harvested.extend(self.executors[t].drain())
+        out = self._drain_entries()
+        # EOS drain accounting (VERDICT r4 #3): how long the finite-
+        # run tail waits on the wire and how deep the backlog was —
+        # the end-to-end-vs-ingest gap is exactly this number
         stats_add("drain_ms", 1e3 * (time.monotonic() - t_eos))
         stats_max("drain_backlog_max", backlog)
-        return self._harvest(harvested)
+        return out
+
+    def flush(self) -> np.ndarray:
+        if self._delegate is not None:
+            return self._delegate.flush()
+        return self._harvest(self._eos_and_drain())
 
     def use_incremental(self):
         raise TypeError("the device path is non-incremental only "
@@ -565,7 +886,11 @@ class NativeResidentCore:
         pending = lib.wf_launch_pending(handle)
         if pending == 0:
             return False
-        coalesce = not os.environ.get("WF_NO_COALESCE")
+        # recovery mode never coalesces: merged launches would make the
+        # per-launch emission boundaries wall-clock-dependent (replay
+        # would regroup differently and break the per-edge seq dedup)
+        coalesce = (not os.environ.get("WF_NO_COALESCE")
+                    and not self._recovery_mode)
         if (coalesce and not force and pending <= self._max_pending
                 and self.max_delay_s is None):
             # (beyond _max_pending the hold is skipped: the producer's

@@ -56,7 +56,10 @@ class WinSeqNode(Node):
                 f"deep-copyable ({type(e).__name__}: {e})") from e
 
     def state_restore(self, snap):
-        if "core" in snap:
+        # the native core's snapshot is a lazy handle object, not a
+        # dict — anything that isn't the deep-copy form goes to the
+        # core's own restore hook
+        if isinstance(snap, dict) and "core" in snap:
             import copy
             self.core = copy.deepcopy(snap["core"])
         else:

@@ -105,6 +105,11 @@ class Supervisor:
                 if core is not None and hasattr(core, "snapshot_rings"):
                     # mirror the ring-snapshot knob onto resident cores
                     core.snapshot_rings = self.policy.snapshot_rings
+                if core is not None and hasattr(core, "_obs_metrics"):
+                    # hand cores with their own snapshot counters (the
+                    # native core's native_state_* series) the dataflow
+                    # metrics sink — cores hold no dataflow reference
+                    core._obs_metrics = df.metrics
             if journaling:
                 self._expected.add(rec.node_id)
         if self._writer is not None:
