@@ -90,6 +90,29 @@ def _control_line(cur):
     return "control: " + "  ".join(parts) if parts else ""
 
 
+#: plane/portable-checkpoint counters folded onto the plane line, not
+#: the generic counters line (docs/ROBUSTNESS.md "Cross-host recovery")
+_PLANE_COUNTERS = ("plane_handoffs", "ckpt_shipped_bytes",
+                   "ckpt_fetched_bytes", "ckpt_spooled", "ckpt_fallbacks")
+
+
+def _plane_line(cur):
+    """Plane supervisor state (docs/ROBUSTNESS.md "Cross-host
+    recovery"): membership and down counts from the plane_* gauges plus
+    the handoff / portable-checkpoint counters — empty string when no
+    supervised plane runs."""
+    gauges = cur.get("gauges", {})
+    counters = cur.get("counters", {})
+    parts = []
+    if "plane_members" in gauges:
+        parts.append(f"members={int(gauges['plane_members'])}")
+        parts.append(f"down={int(gauges.get('plane_down', 0))}")
+    for k in _PLANE_COUNTERS:
+        if counters.get(k):
+            parts.append(f"{k}={int(counters[k])}")
+    return "plane: " + "  ".join(parts) if parts else ""
+
+
 def render(cur, prev, events=(), clock=time.localtime):
     """One frame of the view as a string (pure: testable without a tty)."""
     rates = _rates(cur, prev)
@@ -119,8 +142,13 @@ def render(cur, prev, events=(), clock=time.localtime):
     if ctl:
         lines.append("")
         lines.append(ctl)
+    plane = _plane_line(cur)
+    if plane:
+        lines.append("")
+        lines.append(plane)
     counters = {k: v for k, v in cur.get("counters", {}).items()
-                if v and not k.startswith("ctl_")}
+                if v and not k.startswith("ctl_")
+                and k not in _PLANE_COUNTERS}
     # wire resume telemetry (docs/ROBUSTNESS.md "Wire resume"): the
     # journal depth is a gauge, not a counter — fold it (and any other
     # wire_ gauges) onto the same line so one glance shows resumes,

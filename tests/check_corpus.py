@@ -14,6 +14,7 @@ from windflow_tpu.api import MultiPipe
 from windflow_tpu.core.tuples import Schema
 from windflow_tpu.core.windows import WinType
 from windflow_tpu.parallel.channel import WireConfig
+from windflow_tpu.parallel.plane import PlanePolicy
 from windflow_tpu.patterns.basic import Sink, Source, Map
 from windflow_tpu.patterns.pane_farm import PaneFarm
 from windflow_tpu.patterns.win_seq import WinSeq
@@ -23,13 +24,17 @@ SCHEMA = Schema(value=np.int64)
 
 #: WF### ids the CLI run over this module must report
 PLANTED = ("WF102", "WF103", "WF204", "WF205", "WF207", "WF208",
-           "WF213", "WF214", "WF301")
+           "WF213", "WF214", "WF216", "WF301")
 
 #: module-level scan target: heartbeat at/above the stall timeout
 BAD_WIRE = WireConfig(heartbeat=5.0, stall_timeout=2.0)   # -> WF205
 
 #: module-level scan target: journal that can never trim (no acks)
 BAD_RESUME_WIRE = WireConfig(resume=True)                 # -> WF214
+
+#: module-level scan target: supervised plane whose handoff promise
+#: has no journals to replay from
+BAD_PLANE = PlanePolicy(wire=WireConfig.hardened())       # -> WF216
 
 
 def _red(key, gwid, rows):
@@ -92,4 +97,5 @@ def _race_pipe() -> MultiPipe:
 
 def wf_check_pipelines():
     return [_window_pipe(), _overload_pipe(), _recovery_pipe(),
-            _trace_pipe(), _race_pipe(), BAD_WIRE, BAD_RESUME_WIRE]
+            _trace_pipe(), _race_pipe(), BAD_WIRE, BAD_RESUME_WIRE,
+            BAD_PLANE]

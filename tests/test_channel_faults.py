@@ -218,8 +218,19 @@ def test_truncated_frame_is_an_error():
 def test_garbage_frame_length_is_an_error():
     recv = RowReceiver(n_senders=1)
     raw = socket.create_connection(("127.0.0.1", recv.port))
-    raw.sendall(_LEN.pack(-7))
+    raw.sendall(_LEN.pack(-99))   # below every known control family
     with pytest.raises(ChannelError, match="bad row-channel frame"):
+        list(recv.batches())
+    raw.close()
+
+
+def test_ckpt_frame_with_garbage_subtype_is_an_error():
+    """-7 is the portable-checkpoint family: a frame carrying an unknown
+    subtype must raise, not hang waiting for a payload."""
+    recv = RowReceiver(n_senders=1)
+    raw = socket.create_connection(("127.0.0.1", recv.port))
+    raw.sendall(_LEN.pack(-7) + _LEN.pack(99))
+    with pytest.raises(ChannelError, match="ckpt subtype"):
         list(recv.batches())
     raw.close()
 

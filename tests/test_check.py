@@ -31,6 +31,7 @@ from windflow_tpu.patterns.key_farm import KeyFarm
 from windflow_tpu.core.tuples import Schema
 from windflow_tpu.core.windows import WindowSpec, WinType
 from windflow_tpu.parallel.channel import WireConfig
+from windflow_tpu.parallel.plane import PlanePolicy
 from windflow_tpu.patterns.basic import (Map, Sink, Source,
                                          _AccumulatorNode)
 from windflow_tpu.patterns.pane_farm import PaneFarm
@@ -259,6 +260,10 @@ CORPUS = {
     "WF214": (lambda t: WireConfig(resume=True),
               lambda t: WireConfig(resume=True, recovery=True)),
     "WF215": (lambda t: _native_df(), lambda t: _native_df(abi=True)),
+    "WF216": (lambda t: PlanePolicy(wire=WireConfig.hardened()),
+              lambda t: PlanePolicy(wire=WireConfig(
+                  connect_deadline=60.0, heartbeat=2.0,
+                  stall_timeout=10.0, resume=True, recovery=True))),
     "WF301": (lambda t: _race_pipe(guarded=False),
               lambda t: _race_pipe(guarded=True)),
     "WF302": (lambda t: _global_pipe(True),

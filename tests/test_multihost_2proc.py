@@ -495,3 +495,348 @@ def test_partition_and_ship_rejects_uncovered_owner():
     owners = np.array([0, 1, 2, 0, 1, 2])
     with _pytest.raises(KeyError, match="no\\s+RowSender"):
         partition_and_ship(b, owners, 0, {1: object()})
+
+
+# ---------------------------------------------------------------------------
+# cross-host recovery (docs/ROBUSTNESS.md "Cross-host recovery"): a feeder
+# (pid 0) journals a keyed stream to two stateful workers over the row
+# plane; each worker seals per-epoch state into a CheckpointStore,
+# replicates it to its peer as a portable checkpoint, and acks the sealed
+# epoch so the feeder's journal trims.  The kill test hard-kills one worker
+# and asserts the survivor's PlaneSupervisor adopts it (restore at the last
+# sealed epoch + takeover receiver replaying the journal tail); the roll
+# test restarts BOTH workers mid-stream while the feeder keeps emitting.
+# In both, the merged outputs must be byte-identical to the uncrashed
+# single-process oracle — no gaps, no duplicates.
+
+_PLANE_FEEDER = r"""
+import json, sys, time
+import numpy as np
+from windflow_tpu.core.tuples import Schema, batch_from_columns
+from windflow_tpu.parallel.channel import RowSender, WireResume
+
+d1, d2, n_epochs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+out_path = sys.argv[4]
+schema = Schema(value=np.int64)
+senders = {w: RowSender("127.0.0.1", p, resume=WireResume(deadline=120.0),
+                        connect_deadline=60.0)
+           for w, p in ((1, d1), (2, d2))}
+bi = 0
+for epoch in range(1, n_epochs + 1):
+    for _ in range(2):
+        keys = np.arange(8, dtype=np.int64)
+        ids = np.full(8, bi, dtype=np.int64)
+        vals = 7 * ids + keys + 1
+        for w in (1, 2):
+            m = (1 + keys % 2) == w
+            senders[w].send(batch_from_columns(
+                schema, key=keys[m], id=ids[m], ts=ids[m], value=vals[m]))
+        bi += 1
+    for w in (1, 2):
+        senders[w].send_epoch(epoch)
+    time.sleep(0.1)   # keep emitting WHILE kills/rolls happen downstream
+for w in (1, 2):
+    senders[w].close()
+with open(out_path, "w") as f:
+    json.dump({"batches": bi}, f)
+"""
+
+_PLANE_WORKER = r"""
+import json, os, sys, threading, time
+from windflow_tpu.parallel.channel import (RowReceiver, RowSender,
+                                           WireConfig, WireResume)
+from windflow_tpu.parallel.plane import PlanePolicy, PlaneSupervisor
+from windflow_tpu.recovery.epoch import EpochMarker
+from windflow_tpu.recovery.portable import PortableSpool
+from windflow_tpu.recovery.store import CheckpointStore
+
+w = int(sys.argv[1])
+d1, d2, m1, m2 = (int(a) for a in sys.argv[2:6])
+root, die_after, summary_path = sys.argv[6], int(sys.argv[7]), sys.argv[8]
+peer = 3 - w
+my_data, my_mon = (d1, m1) if w == 1 else (d2, m2)
+peer_mon = m2 if w == 1 else m1
+
+store = CheckpointStore(os.path.join(root, f"store{w}"), retain=8)
+spool = PortableSpool(os.path.join(root, f"spool{w}"))
+
+# data plane: the feeder's journaling sender; acks are manual, at SEAL
+recv = RowReceiver(1, port=my_data, resume=WireResume(deadline=120.0),
+                   ack_epochs=False, accept_timeout=60.0)
+# monitor plane: peer liveness (its death = our link EOF) + the landing
+# zone for the peer's replicated portable checkpoints
+mon_recv = RowReceiver(1, port=my_mon, resume=WireResume(deadline=240.0),
+                       accept_timeout=60.0, ckpt_sink=spool)
+mon_snd = RowSender("127.0.0.1", peer_mon, resume=WireResume(deadline=240.0),
+                    connect_deadline=60.0)
+
+adopted_rows, alock = [], threading.Lock()
+ctx = {}
+adopt_started, adopt_done = threading.Event(), threading.Event()
+
+
+def apply(rows, sums, sink):
+    for r in rows:
+        k, v = int(r["key"]), int(r["value"])
+        sums[k] = sums.get(k, 0) + v
+        sink.append([k, int(r["id"]), sums[k]])
+
+
+def on_adopt(dead, epoch, st):
+    ctx["adopted_from"] = [int(dead), int(epoch)]
+
+    def run():
+        try:
+            sums2 = st.load(int(epoch), "sums")
+            tr = ctx["sup"].takeover_receiver(dead, epoch, n_senders=1)
+            pend = []
+            for item in tr.batches(epoch_markers=True):
+                if isinstance(item, EpochMarker):
+                    with alock:
+                        adopted_rows.extend(pend)
+                    pend = []
+                    tr.ack_epoch(int(item.epoch))
+                    continue
+                apply(item, sums2, pend)
+            tr.close()
+        except Exception as e:                      # noqa: BLE001
+            ctx["adopt_error"] = repr(e)
+        finally:
+            adopt_done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    adopt_started.set()
+
+
+policy = PlanePolicy(
+    down_deadline=2.0, period=0.1, candidates={1, 2},
+    wire=WireConfig(connect_deadline=60.0, heartbeat=2.0,
+                    stall_timeout=30.0, resume=True, recovery=False))
+sup = PlaneSupervisor(w, {1: ("127.0.0.1", d1), 2: ("127.0.0.1", d2)},
+                      {peer: mon_snd}, policy=policy, store=store,
+                      spool=spool, on_adopt=on_adopt)
+ctx["sup"] = sup
+sup.start()
+
+sums, pending = {}, []
+out_f = open(os.path.join(root, f"out{w}.jsonl"), "a")
+for item in recv.batches(epoch_markers=True):
+    if isinstance(item, EpochMarker):
+        e = int(item.epoch)
+        n = store.save_blob(e, "sums", dict(sums))
+        store.commit(e, {"sums": {"bytes": n}})
+        for row in pending:
+            out_f.write(json.dumps(row) + "\n")
+        out_f.flush()
+        os.fsync(out_f.fileno())
+        pending = []
+        sup.replicate(e)
+        recv.ack_epoch(e)
+        if die_after and e >= die_after:
+            os._exit(1)   # kill -9: no EOS, no teardown, nothing flushed
+        continue
+    apply(item, sums, pending)
+
+if adopt_started.wait(0.5):
+    assert adopt_done.wait(120.0), "adopted tail never finished"
+    assert "adopt_error" not in ctx, ctx["adopt_error"]
+
+recv.close()
+sup.close()
+mon_snd.abort()
+mon_recv.close()
+with alock:
+    rows = list(adopted_rows)
+with open(summary_path, "w") as f:
+    json.dump({"pid": w, "adopted_from": ctx.get("adopted_from"),
+               "adopted_rows": rows}, f)
+"""
+
+_ROLL_WORKER = r"""
+import json, os, sys
+from windflow_tpu.parallel.channel import RowReceiver, WireResume
+from windflow_tpu.recovery.epoch import EpochMarker
+from windflow_tpu.recovery.store import CheckpointStore
+
+w = int(sys.argv[1])
+port, root = int(sys.argv[2]), sys.argv[3]
+stop_after, resume_from = int(sys.argv[4]), int(sys.argv[5])
+
+store = CheckpointStore(os.path.join(root, f"store{w}"), retain=8)
+sums = {}
+if resume_from:
+    latest = store.latest_complete()
+    assert latest is not None and latest[0] == resume_from, latest
+    sums = store.load(resume_from, "sums")
+
+recv = RowReceiver(1, port=port, resume=WireResume(deadline=120.0),
+                   resume_epoch=(resume_from or None), ack_epochs=False,
+                   accept_timeout=60.0)
+pending = []
+out_f = open(os.path.join(root, f"out{w}.jsonl"), "a")
+for item in recv.batches(epoch_markers=True):
+    if isinstance(item, EpochMarker):
+        e = int(item.epoch)
+        n = store.save_blob(e, "sums", dict(sums))
+        store.commit(e, {"sums": {"bytes": n}})
+        for row in pending:
+            out_f.write(json.dumps(row) + "\n")
+        out_f.flush()
+        os.fsync(out_f.fileno())
+        pending = []
+        recv.ack_epoch(e)
+        if stop_after and e >= stop_after:
+            os._exit(0)   # rolling restart: exit at the seal, no EOS —
+            #               the feeder's journal bridges the gap
+        continue
+    for r in item:
+        k, v = int(r["key"]), int(r["value"])
+        sums[k] = sums.get(k, 0) + v
+        pending.append([k, int(r["id"]), sums[k]])
+recv.close()
+"""
+
+
+def _plane_oracle(n_epochs):
+    """Uncrashed single-process oracle: per-key running sums over the
+    deterministic feeder stream, as {key: [[id, cum], ...]}."""
+    want, sums = {}, {}
+    for bi in range(2 * n_epochs):
+        for k in range(8):
+            v = 7 * bi + k + 1
+            sums[k] = sums.get(k, 0) + v
+            want.setdefault(k, []).append([bi, sums[k]])
+    return want
+
+
+def _plane_rows(*paths):
+    """Merge [key, id, cum] row files/lists into {key: rows-by-id}."""
+    per_key = {}
+    for rows in paths:
+        for k, rid, cum in rows:
+            per_key.setdefault(int(k), []).append([int(rid), int(cum)])
+    for rows in per_key.values():
+        rows.sort()
+    return per_key
+
+
+def _jsonl(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_three_process_kill_and_adopt(tmp_path):
+    """ISSUE 18 acceptance: kill -9 one worker of a 3-process plane
+    (feeder + 2 stateful workers).  The survivor's PlaneSupervisor must
+    detect the death past the down-deadline, elect itself, restore the
+    dead peer's state from its replicated portable checkpoint at the
+    last SEALED epoch, and rebind the dead peer's address as a resume
+    receiver — the feeder's journal replays exactly the unsealed tail.
+    Merged outputs (survivor + dead worker's sealed prefix + adopted
+    tail) must equal the uncrashed oracle: no gaps, no duplicates."""
+    d1, d2, m1, m2 = (_free_port() for _ in range(4))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    feeder_py = tmp_path / "feeder.py"
+    feeder_py.write_text(_PLANE_FEEDER)
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(_PLANE_WORKER)
+    root = str(tmp_path)
+    n_epochs = 6
+
+    procs = []
+    try:
+        workers = {}
+        for w, die_after in ((1, 0), (2, 2)):   # worker 2 dies at epoch 2
+            workers[w] = subprocess.Popen(
+                [sys.executable, str(worker_py), str(w), str(d1), str(d2),
+                 str(m1), str(m2), root, str(die_after),
+                 str(tmp_path / f"summary{w}.json")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            procs.append(workers[w])
+        feeder = subprocess.Popen(
+            [sys.executable, str(feeder_py), str(d1), str(d2),
+             str(n_epochs), str(tmp_path / "feeder.json")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(feeder)
+        _out, err2 = workers[2].communicate(timeout=240)
+        assert workers[2].returncode == 1, (workers[2].returncode,
+                                            err2.decode()[-4000:])
+        _out, err_f = feeder.communicate(timeout=240)
+        assert feeder.returncode == 0, err_f.decode()[-4000:]
+        _out, err1 = workers[1].communicate(timeout=240)
+        assert workers[1].returncode == 0, err1.decode()[-4000:]
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+
+    summary = json.loads((tmp_path / "summary1.json").read_text())
+    assert summary["adopted_from"] == [2, 2], summary["adopted_from"]
+    merged = _plane_rows(_jsonl(os.path.join(root, "out1.jsonl")),
+                         _jsonl(os.path.join(root, "out2.jsonl")),
+                         summary["adopted_rows"])
+    assert merged == _plane_oracle(n_epochs)
+
+
+def test_rolling_restart_zero_loss(tmp_path):
+    """ISSUE 18 acceptance: roll every stateful worker of the plane —
+    each seals an epoch, exits without EOS, and restarts with
+    ``resume_epoch=`` at its own sealed checkpoint — while the feeder
+    keeps emitting the whole time (its journaling senders bridge each
+    restart gap and replay the unsealed tail to the rebooted process).
+    Merged outputs must equal the uncrashed oracle: zero record loss,
+    zero duplication."""
+    d1, d2 = _free_port(), _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    feeder_py = tmp_path / "feeder.py"
+    feeder_py.write_text(_PLANE_FEEDER)
+    worker_py = tmp_path / "roll_worker.py"
+    worker_py.write_text(_ROLL_WORKER)
+    root = str(tmp_path)
+    n_epochs = 8
+    rolls = {1: 2, 2: 5}   # worker -> epoch it restarts at
+
+    procs = []
+
+    def spawn_worker(w, port, stop_after, resume_from):
+        p = subprocess.Popen(
+            [sys.executable, str(worker_py), str(w), str(port), root,
+             str(stop_after), str(resume_from)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(p)
+        return p
+
+    try:
+        phase_a = {w: spawn_worker(w, p, rolls[w], 0)
+                   for w, p in ((1, d1), (2, d2))}
+        feeder = subprocess.Popen(
+            [sys.executable, str(feeder_py), str(d1), str(d2),
+             str(n_epochs), str(tmp_path / "feeder.json")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(feeder)
+        phase_b = {}
+        for w, port in ((1, d1), (2, d2)):      # roll in plane order
+            _out, err = phase_a[w].communicate(timeout=240)
+            assert phase_a[w].returncode == 0, err.decode()[-4000:]
+            phase_b[w] = spawn_worker(w, port, 0, rolls[w])
+        _out, err_f = feeder.communicate(timeout=240)
+        assert feeder.returncode == 0, err_f.decode()[-4000:]
+        for w in (1, 2):
+            _out, err = phase_b[w].communicate(timeout=240)
+            assert phase_b[w].returncode == 0, err.decode()[-4000:]
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+
+    merged = _plane_rows(_jsonl(os.path.join(root, "out1.jsonl")),
+                         _jsonl(os.path.join(root, "out2.jsonl")))
+    assert merged == _plane_oracle(n_epochs)

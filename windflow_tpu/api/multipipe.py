@@ -396,6 +396,23 @@ class MultiPipe:
         for scripted ``request_rescale`` calls (docs/CONTROL.md)."""
         return self._df._controller if self._df is not None else None
 
+    def request_drain(self, timeout: float = None) -> bool:
+        """Gate every source and wait for in-flight work to settle —
+        the quiesce leg of a rolling restart (docs/ROBUSTNESS.md
+        "Cross-host recovery").  Needs a running pipe whose ``control=``
+        policy declares a :class:`~windflow_tpu.control.Drain` rule."""
+        if self._df is None:
+            raise RuntimeError("request_drain() needs a running pipe — "
+                               "call after run()")
+        return self._df.request_drain(timeout)
+
+    def release_drain(self):
+        """Reopen the source gate closed by :meth:`request_drain`."""
+        if self._df is None:
+            raise RuntimeError("release_drain() needs a running pipe — "
+                               "call after run()")
+        self._df.release_drain()
+
     def getNumThreads(self) -> int:
         """Thread count of the materialised graph (multipipe.hpp:973).
         Before run() this builds a throwaway preview graph, so the pipe

@@ -37,16 +37,23 @@ def validate(target) -> CheckReport:
     ``target`` may be a :class:`~windflow_tpu.api.multipipe.MultiPipe`
     (built on demand — pre-build config conflicts that would make the
     build itself raise, e.g. WF208, are reported instead of raised), a
-    built :class:`~windflow_tpu.runtime.engine.Dataflow`, or a
-    :class:`~windflow_tpu.parallel.channel.WireConfig`.
+    built :class:`~windflow_tpu.runtime.engine.Dataflow`, a
+    :class:`~windflow_tpu.parallel.channel.WireConfig`, or a
+    :class:`~windflow_tpu.parallel.plane.PlanePolicy`.
     """
-    from .config import check_pipe_config, check_wire
+    from .config import check_pipe_config, check_plane, check_wire
     from .graph import check_dataflow
 
     report = CheckReport()
     kind = type(target).__name__
     if kind == "WireConfig":
         report.extend(check_wire(target))
+        return report.finish()
+    if kind == "PlanePolicy":
+        # dispatched by type NAME, like WireConfig: the check package
+        # must not import parallel.plane (the knob contract keeps that
+        # module un-imported until a supervisor is actually built)
+        report.extend(check_plane(target))
         return report.finish()
     if hasattr(target, "_build") and hasattr(target, "_stages"):
         # a MultiPipe: pre-build knob checks first — a fatal knob

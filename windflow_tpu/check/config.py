@@ -44,6 +44,30 @@ def check_wire(cfg) -> list[Diagnostic]:
     return diags
 
 
+def check_plane(policy) -> list[Diagnostic]:
+    """WF216 (plus the wire's own WF205/206/214) over one
+    :class:`~windflow_tpu.parallel.plane.PlanePolicy`: a supervised
+    plane promises handoff — the successor's takeover receiver resumes
+    from the dead peer's last sealed epoch and expects every surviving
+    sender to REPLAY its journaled tail.  Without ``resume=`` on the
+    plane's wire there is no journal, so the frames in flight at the
+    death are silently lost at every handoff."""
+    wire = getattr(policy, "wire", None)
+    diags = [] if wire is None else list(check_wire(wire))
+    if wire is None or not getattr(wire, "resume", None):
+        diags.append(Diagnostic(
+            "WF216",
+            f"PlanePolicy wire "
+            f"{'is unset' if wire is None else 'has no resume='}: the "
+            f"supervisor's handoff rebinds a dead peer's address with "
+            f"resume_epoch=, but non-journaling senders cannot replay "
+            f"their in-flight tail to the successor — every handoff "
+            f"silently drops the frames in flight at the death (set "
+            f"WireConfig(resume=True, recovery=True) on the plane; "
+            f"docs/ROBUSTNESS.md \"Cross-host recovery\")"))
+    return diags
+
+
 def _obs_configured(metrics, sample_period) -> bool:
     # mirror the engine's truthiness rule: metrics=False/0 means OFF
     return bool(metrics) or sample_period is not None

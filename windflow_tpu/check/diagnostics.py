@@ -87,6 +87,10 @@ CATALOG: dict[str, tuple[str, str]] = {
               "lacks the state ABI: default execution runs, but the "
               "first snapshot or migration declines with "
               "SnapshotUnsupported"),
+    "WF216": (WARNING,
+              "plane supervisor/rolling restart over a wire without "
+              "resume=: at handoff the dead process's in-flight frames "
+              "have no journal to replay from and are silently lost"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "

@@ -24,8 +24,9 @@ coupling, so the seed hot paths stay byte-identical.
 from __future__ import annotations
 
 from .controller import Controller, TokenBucket
-from .policy import Admission, AdaptiveShed, ControlPolicy, Rescale
+from .policy import Admission, AdaptiveShed, ControlPolicy, Drain, Rescale
 from .rescale import FarmController, RescaleError
 
 __all__ = ["ControlPolicy", "Rescale", "AdaptiveShed", "Admission",
-           "Controller", "TokenBucket", "FarmController", "RescaleError"]
+           "Drain", "Controller", "TokenBucket", "FarmController",
+           "RescaleError"]

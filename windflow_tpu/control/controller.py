@@ -26,7 +26,7 @@ import threading
 from time import monotonic as _monotonic
 from time import sleep as _sleep
 
-from .policy import Admission, AdaptiveShed, ControlPolicy, Rescale
+from .policy import Admission, AdaptiveShed, ControlPolicy, Drain, Rescale
 
 
 class TokenBucket:
@@ -92,6 +92,10 @@ class Controller:
         self._orig_soft_limit = None
         self.admissions: list[_AdmissionState] = []
         self._prev_shed: dict[str, tuple[float, int]] = {}
+        self.drain_rule: Drain | None = None
+        #: set = sources flow; cleared = sources gate at emit
+        self._drain_gate = threading.Event()
+        self._drain_gate.set()
 
     # ------------------------------------------------------------ wiring
 
@@ -187,6 +191,30 @@ class Controller:
                     self._wrap_source(s, bucket)
                 self.admissions.append(
                     _AdmissionState(rule, bucket, gauge, sources))
+            elif isinstance(rule, Drain):
+                self.drain_rule = rule
+        if self.drain_rule is not None:
+            # gate OUTERMOST (after any Admission wrap): a drained
+            # source parks before it spends bucket tokens, and resumes
+            # rate-capped exactly as it left
+            for n in df.nodes:
+                if isinstance(n, SourceNode):
+                    self._gate_source(n)
+            df.metrics.gauge("ctl_draining").set(0)
+
+    def _gate_source(self, node):
+        inner = node.emit           # possibly the Admission wrapper
+        gate = self._drain_gate
+        failed = self.df._failed
+
+        def emit(batch):
+            while not gate.wait(0.05):
+                if failed.is_set():
+                    from ..runtime.engine import _Cancelled
+                    raise _Cancelled()
+            inner(batch)
+
+        node.emit = emit            # Shipper captures this at generate()
 
     def _wrap_source(self, node, bucket: TokenBucket):
         inner = node.emit           # the bound class method
@@ -290,15 +318,77 @@ class Controller:
                    rule.pattern or "<sources>", round(new, 3),
                    depth=depth)
 
+    # ------------------------------------------------------------- drain
+
+    @property
+    def draining(self) -> bool:
+        return not self._drain_gate.is_set()
+
+    def request_drain(self, timeout: float = None) -> bool:
+        """Close the source gate and wait for the in-flight work to
+        settle: returns True once every node inbox has stayed empty for
+        two consecutive polls, False when ``timeout`` (default: the
+        rule's ``deadline``) elapsed first — the graph is still gated
+        either way, the caller decides whether a partial quiesce is
+        good enough to seal on.  Idempotent while already draining."""
+        rule = self.drain_rule
+        if rule is None:
+            raise RuntimeError(
+                "request_drain() needs a Drain rule in the "
+                "ControlPolicy — the source gate is only wired when "
+                "the policy declares it (docs/CONTROL.md)")
+        df = self.df
+        deadline = rule.deadline if timeout is None else float(timeout)
+        if self._drain_gate.is_set():
+            self._drain_gate.clear()
+            df.metrics.gauge("ctl_draining").set(1)
+            df.metrics.counter("ctl_drains").inc()
+            self._drain_note("requested", deadline=deadline)
+        t0 = _monotonic()
+        settled = 0
+        while _monotonic() - t0 < deadline:
+            if df._failed.is_set():
+                self._drain_note("failed", reason="dataflow failed")
+                return False
+            depth = sum(ib.depth() for ib in df._inboxes.values())
+            # two consecutive empty polls: one poll can race a batch
+            # in flight between an inbox pop and the next node's put
+            settled = settled + 1 if depth == 0 else 0
+            if settled >= 2:
+                self._drain_note("quiesced",
+                                 ms=round((_monotonic() - t0) * 1e3, 1))
+                return True
+            _sleep(rule.poll)
+        self._drain_note("timeout", deadline=deadline,
+                         depth=sum(ib.depth()
+                                   for ib in df._inboxes.values()))
+        return False
+
+    def release_drain(self):
+        """Reopen the source gate (no-op when not draining): sources
+        resume mid-iteration exactly where they parked."""
+        if not self._drain_gate.is_set():
+            self._drain_gate.set()
+            self.df.metrics.gauge("ctl_draining").set(0)
+            self._drain_note("released")
+
+    def _drain_note(self, phase: str, **fields):
+        df = self.df
+        if df.events is not None:
+            df.events.emit("drain", dataflow=df.name, phase=phase,
+                           **fields)
+
     # --------------------------------------------------------- lifecycle
 
     def close(self):
         """Called from ``Dataflow.wait()``: undo runtime mutations of
         user-owned objects — the adaptively tightened ``soft_limit``
         belongs to this run, not to the OverloadPolicy instance the user
-        may reuse elsewhere.  Idempotent."""
+        may reuse elsewhere.  Also reopens the drain gate so a gated
+        source thread cannot outlive the run parked.  Idempotent."""
         if self.shed_rule is not None and self.df.overload is not None:
             self.df.overload.soft_limit = self._orig_soft_limit
+        self._drain_gate.set()
 
     # ------------------------------------------------------------ manual
 

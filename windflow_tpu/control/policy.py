@@ -270,6 +270,50 @@ class Admission(_ThresholdRule):
                 f"low_depth={self.low})")
 
 
+class Drain:
+    """Manual quiesce actuator: registering a ``Drain`` rule arms
+    ``Dataflow.request_drain()`` / ``release_drain()`` — the first leg
+    of the rolling-restart sequence (docs/ROBUSTNESS.md "Cross-host
+    recovery", scripts/wf_roll.py).
+
+    Draining closes a gate in front of EVERY source's emission (the
+    same wrap point as :class:`Admission`'s token bucket, so already
+    -emitted batches keep flowing downstream), then waits for the
+    in-flight work to settle: ``request_drain`` returns once every node
+    inbox has stayed empty, or ``deadline`` seconds elapsed — the
+    caller seals a checkpoint on the quiesced graph and hands off.
+    ``release_drain`` reopens the gate; sources resume exactly where
+    they blocked, no record dropped.
+
+    Unlike the threshold rules this one never fires from samples — it
+    is driven by the operator (a roll sequencer, a scripted failover).
+    At most one per policy: there is one gate.
+    """
+
+    __slots__ = ("deadline", "poll")
+
+    def __init__(self, deadline: float = 30.0, poll: float = 0.05):
+        if float(deadline) <= 0:
+            raise ValueError("deadline must be positive seconds")
+        if float(poll) <= 0:
+            raise ValueError("poll must be positive seconds")
+        self.deadline = float(deadline)
+        self.poll = float(poll)
+
+    def reset(self):
+        """No trigger state to clear (manual actuator) — present so the
+        Controller's uniform ``rule.reset()`` at attach stays simple."""
+
+    def observe(self, value, now: float) -> int:
+        return 0    # never fires from samples
+
+    def _key(self):
+        return ("drain", self.deadline, self.poll)
+
+    def __repr__(self):
+        return f"Drain(deadline={self.deadline}, poll={self.poll})"
+
+
 class ControlPolicy:
     """Per-dataflow control-plane knobs: the rules plus the evaluation
     cadence.
@@ -278,9 +322,10 @@ class ControlPolicy:
     ----------
     rules:
         Non-empty list of :class:`Rescale` / :class:`AdaptiveShed` /
-        :class:`Admission` rules.  At most one ``Rescale`` per pattern
-        name and at most one ``AdaptiveShed`` (it moves one dataflow-wide
-        knob).
+        :class:`Admission` / :class:`Drain` rules.  At most one
+        ``Rescale`` per pattern name, at most one ``AdaptiveShed`` (it
+        moves one dataflow-wide knob) and at most one ``Drain`` (one
+        gate).
     period:
         Controller evaluation cadence in seconds.  The controller is fed
         by the observability sampler (``Sampler.subscribe``): when
@@ -298,10 +343,11 @@ class ControlPolicy:
         if not rules:
             raise ValueError("ControlPolicy needs at least one rule")
         for r in rules:
-            if not isinstance(r, (Rescale, AdaptiveShed, Admission)):
+            if not isinstance(r, (Rescale, AdaptiveShed, Admission,
+                                  Drain)):
                 raise TypeError(
                     f"unknown rule type {type(r).__name__} (want "
-                    f"Rescale / AdaptiveShed / Admission)")
+                    f"Rescale / AdaptiveShed / Admission / Drain)")
         seen = set()
         for r in rules:
             if isinstance(r, Rescale):
@@ -313,6 +359,9 @@ class ControlPolicy:
         if sum(isinstance(r, AdaptiveShed) for r in rules) > 1:
             raise ValueError("at most one AdaptiveShed rule: it moves "
                              "the single dataflow-wide soft_limit")
+        if sum(isinstance(r, Drain) for r in rules) > 1:
+            raise ValueError("at most one Drain rule: it owns the "
+                             "single dataflow-wide source gate")
         adm = [r for r in rules if isinstance(r, Admission)]
         adm_pats = [r.pattern for r in adm]
         if len(adm) > 1 and (None in adm_pats

@@ -36,6 +36,12 @@ EVENT_KINDS = frozenset({
     # recovery (windflow_tpu/recovery/, docs/ROBUSTNESS.md "Recovery")
     "epoch", "checkpoint", "checkpoint_commit", "checkpoint_skip",
     "restore", "node_restart", "recovery_giveup",
+    # cross-host recovery (parallel/plane.py, recovery/portable.py,
+    # docs/ROBUSTNESS.md "Cross-host recovery"): membership transitions
+    # of a supervised plane, successor handoff phases, the drain
+    # actuator's quiesce phases, and a checkpoint store skipping a
+    # torn/corrupt epoch at latest_complete()
+    "membership", "handoff", "drain", "checkpoint_fallback",
     # static analysis (windflow_tpu/check/, docs/CHECKS.md): one event
     # per pre-flight diagnostic when the check= knob runs on an
     # observed graph

@@ -89,6 +89,16 @@ _RS_SEQ = 3            # sender->receiver: 8-byte seq tagging the NEXT
 _RS_ACK = 4            # receiver->sender: JSON {"epoch": E} | {"seq": S}
 #                        — cumulative sealed ack; the sender trims its
 #                        journal through it
+_CKPT_FRAME = -7       # portable-checkpoint family (docs/ROBUSTNESS.md
+#                        "Cross-host recovery"); an 8-byte subtype
+#                        follows:
+_CK_OFFER = 1          # sender->receiver: length-prefixed JSON portable
+#                        header {v, origin, epoch, partial, nodes}
+_CK_BLOB = 2           # sender->receiver: length-prefixed JSON envelope
+#                        {origin, epoch, node, bytes, crc}, then the raw
+#                        blob of exactly `bytes` bytes
+_CK_COMMIT = 3         # sender->receiver: length-prefixed JSON {origin,
+#                        epoch} — every blob shipped, the spool may seal
 
 
 def _send_resume_frame(sock, sub: int, payload: dict):
@@ -304,7 +314,8 @@ class _WireTelemetry:
                  "frames_recv", "connect_retries", "heartbeats_sent",
                  "heartbeats_recv", "heartbeat_misses", "traces_sent",
                  "traces_recv", "resumes", "replayed_frames", "acks_sent",
-                 "acks_recv", "journal_depth")
+                 "acks_recv", "journal_depth", "ckpt_shipped_bytes",
+                 "ckpt_fetched_bytes")
 
     def __init__(self, metrics, events=None):
         self.events = events
@@ -325,6 +336,9 @@ class _WireTelemetry:
         self.acks_sent = c("wire_acks_sent")
         self.acks_recv = c("wire_acks_recv")
         self.journal_depth = metrics.gauge("wire_journal_depth")
+        # portable checkpoints (docs/ROBUSTNESS.md "Cross-host recovery")
+        self.ckpt_shipped_bytes = c("ckpt_shipped_bytes")
+        self.ckpt_fetched_bytes = c("ckpt_fetched_bytes")
 
     def emit(self, event: str, **fields):
         if self.events is not None:
@@ -750,7 +764,11 @@ class RowSender:
         except OSError:
             pass
         self._hb_error = None
-        self._link_down = False
+        # the link IS down until the cycle completes: anything polling
+        # the flag concurrently (plane supervisor membership, a
+        # replicate() skip) must see the truth mid-cycle, or a peer
+        # death is masked for the whole reconnect deadline
+        self._link_down = True
         last = err
         while True:
             left = t_end - time.monotonic()
@@ -778,6 +796,7 @@ class RowSender:
                     pass
                 continue
             break
+        self._link_down = False
         if tm is not None:
             tm.resumes.inc()
             tm.replayed_frames.inc(n)
@@ -883,6 +902,61 @@ class RowSender:
                 self._tm.frames_sent.inc()
                 self._tm.bytes_sent.inc(2 * _LEN.size)
 
+    def send_ckpt(self, header: dict, blobs) -> int:
+        """Stream one sealed epoch's portable checkpoint (``-7`` family,
+        docs/ROBUSTNESS.md "Cross-host recovery"): OFFER header, one
+        BLOB frame per ``(meta, raw)`` of ``blobs``, then COMMIT.  The
+        receiving side must run a ``ckpt_sink=`` (typically a
+        ``recovery.portable.PortableSpool``).
+
+        Checkpoint frames are NOT journaled — shipping is idempotent
+        (the spool seals per (origin, epoch), re-ships overwrite
+        bit-identically), so on a resumable link a mid-ship failure
+        gets one resume cycle (reconnect + data-journal replay) and a
+        clean retransmit from the OFFER; past that — or on a plain
+        link — the failure raises and the caller retries at its next
+        seal.  Like every hardening knob: never sent unless the
+        application calls it, so the bytes on the wire stay
+        seed-identical otherwise."""
+        blobs = list(blobs)
+        with self._send_lock:
+            if self._resume is not None:
+                if self._link_down or self._hb_error is not None:
+                    self._resume_cycle(self._hb_error or ConnectionError(
+                        "row channel link marked down by the ack reader"))
+                try:
+                    return self._transmit_ckpt(header, blobs)
+                except OSError as e:
+                    self._resume_cycle(e)
+                    return self._transmit_ckpt(header, blobs)
+            self._check_alive()
+            return self._transmit_ckpt(header, blobs)
+
+    def _transmit_ckpt(self, header: dict, blobs) -> int:
+        """Write the whole ``-7`` sequence on the current connection.
+        Caller holds _send_lock."""
+        tm = self._tm
+        total = 0
+
+        def _part(sub: int, payload: dict, raw: bytes = b""):
+            js = json.dumps(payload).encode("utf-8")
+            frame = (_LEN.pack(_CKPT_FRAME) + _LEN.pack(sub)
+                     + _LEN.pack(len(js)) + js + raw)
+            self._sock.sendall(frame)
+            return len(frame)
+
+        total += _part(_CK_OFFER, header)
+        for meta, raw in blobs:
+            total += _part(_CK_BLOB, meta, raw)
+        total += _part(_CK_COMMIT, {"origin": header.get("origin"),
+                                    "epoch": header["epoch"]})
+        self._last_send = time.monotonic()
+        if tm is not None:
+            tm.frames_sent.inc(2 + len(blobs))
+            tm.bytes_sent.inc(total)
+            tm.ckpt_shipped_bytes.inc(total)
+        return total
+
     def close(self):
         """Signal EOS (empty frame) and close the socket.  If the EOS
         frame cannot be delivered (peer already dead) the failure is
@@ -897,6 +971,15 @@ class RowSender:
             try:
                 with self._send_lock:
                     try:
+                        if self._link_down or self._hb_error is not None:
+                            # a half-closed link accepts the EOS write
+                            # into the void (peer FIN'd, no RST yet):
+                            # resume FIRST, like _deliver, or a
+                            # restarted peer never hears from us again
+                            self._resume_cycle(
+                                self._hb_error or ConnectionError(
+                                    "row channel link marked down by "
+                                    "the ack reader"))
                         self._transmit_eos()
                     except OSError as e:
                         self._resume_cycle(e)   # ChannelError past the
@@ -971,7 +1054,7 @@ class RowReceiver:
                  stall_timeout: float = None, accept_timeout: float = None,
                  metrics=None, events=None, decode_trace: bool = False,
                  resume=None, resume_epoch: int = None, ack_epochs=None,
-                 wire: WireConfig = None):
+                 ckpt_sink=None, wire: WireConfig = None):
         if wire is not None:
             wire.validate()
             if stall_timeout is None:
@@ -990,6 +1073,13 @@ class RowReceiver:
         #: False (default) consumes and discards them, so a tracing
         #: sender is always safe to point at a non-tracing receiver
         self.decode_trace = bool(decode_trace)
+        #: opt-in portable-checkpoint landing zone (``-7`` family): an
+        #: object with offer(header)/blob(meta, raw)/commit(meta) —
+        #: typically ``recovery.portable.PortableSpool``.  None (the
+        #: default) REFUSES the family loudly: a peer shipping
+        #: checkpoints at an unconfigured receiver is a deployment
+        #: error, not a silent drop.
+        self._ckpt_sink = ckpt_sink
         self.n_senders = int(n_senders)
         self.stall_timeout = stall_timeout
         #: bound on the ACCEPT phase: how long to wait for all senders to
@@ -1280,6 +1370,9 @@ class RowReceiver:
                         f"unexpected resume subtype {sub} mid-stream")
                 pending = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
                 continue
+            if n == _CKPT_FRAME:
+                self._ckpt_frame(conn)
+                continue
             if n == _ABORT_FRAME:
                 if tm is not None:
                     tm.emit("peer_abort", role="receiver")
@@ -1379,6 +1472,38 @@ class RowReceiver:
             except OSError:
                 pass
 
+    def _ckpt_frame(self, conn: socket.socket):
+        """Consume one portable-checkpoint frame (``-7`` family,
+        docs/ROBUSTNESS.md "Cross-host recovery") and hand it to the
+        configured ``ckpt_sink``.  Runs inline on the connection's read
+        thread — a sink failure (CRC mismatch, version skew) surfaces
+        exactly like a torn frame, through the read loop's error path."""
+        sub = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+        if sub not in (_CK_OFFER, _CK_BLOB, _CK_COMMIT):
+            raise ChannelError(f"unexpected ckpt subtype {sub}")
+        meta = _read_resume_json(conn)
+        raw = b""
+        if sub == _CK_BLOB:
+            nb = int(meta.get("bytes", -1))
+            if not 0 <= nb <= (1 << 31):
+                raise ChannelError(f"bad ckpt blob length {nb}")
+            raw = _read_exact(conn, nb)
+        sink = self._ckpt_sink
+        if sink is None:
+            raise ChannelError(
+                "portable-checkpoint frame received but this receiver "
+                "has no ckpt_sink= (give it a recovery.portable."
+                "PortableSpool, or stop the peer's checkpoint shipping)")
+        if self._tm is not None:
+            self._tm.frames_recv.inc()
+            self._tm.ckpt_fetched_bytes.inc(3 * _LEN.size + len(raw))
+        if sub == _CK_OFFER:
+            sink.offer(meta)
+        elif sub == _CK_BLOB:
+            sink.blob(meta, raw)
+        else:
+            sink.commit(meta)
+
     def _next_frame(self, conn: socket.socket):
         """One payload frame as ``(frame, trace_or_None)`` — ``frame``
         is bytes, an :class:`EpochMarker`, or None on clean EOS.
@@ -1422,6 +1547,9 @@ class RowReceiver:
                     # (version-mismatched peer), via _read_loop's
                     # catch-all -> batches() raise
                     trace = json.loads(tp.decode("utf-8"))
+                continue
+            if n == _CKPT_FRAME:
+                self._ckpt_frame(conn)
                 continue
             if n == _ABORT_FRAME:
                 if tm is not None:

@@ -102,3 +102,62 @@ class FaultPlan:
         if self.seed is not None:
             parts.append(f"seed={self.seed}")
         return f"FaultPlan({', '.join(parts)})"
+
+class HandoffChaos:
+    """Plane-level chaos schedule — the handoff analogue of
+    :class:`FaultPlan` (scripts/soak_handoff.py): at which SEALED epoch
+    a plane worker dies (``kill``: its successor adopts via the
+    replicated portable checkpoint, docs/ROBUSTNESS.md "Cross-host
+    recovery") or rolls (``roll``: the same member restarts against its
+    own store with ``resume_epoch=``).  Explicit and seeded like every
+    plan here: a failing seed is the whole bug report."""
+
+    __slots__ = ("kill", "roll", "seed")
+
+    def __init__(self, kill=(), roll=(), seed=None):
+        #: pid -> sealed epoch at which the event fires
+        self.kill = {int(p): int(e) for p, e in kill}
+        self.roll = {int(p): int(e) for p, e in roll}
+        self.seed = seed
+        if set(self.kill) & set(self.roll):
+            raise ValueError("HandoffChaos schedules overlap: a worker "
+                             "cannot both die and roll")
+        if any(e < 1 for e in (*self.kill.values(), *self.roll.values())):
+            raise ValueError("HandoffChaos epochs are 1-based")
+
+    @classmethod
+    def seeded(cls, seed: int, pids, last_epoch: int,
+               kinds=("kill", "roll")) -> "HandoffChaos":
+        """One reproducible plane event: a pid from ``pids`` suffers a
+        kind from ``kinds`` at a sealed epoch in ``[1, last_epoch - 1]``
+        (never the final epoch, so every schedule leaves a tail for the
+        successor/restart to consume)."""
+        bad = [k for k in kinds if k not in ("kill", "roll")]
+        if bad:
+            raise ValueError(f"unknown handoff kind(s) {bad}; "
+                             f"choose from ('kill', 'roll')")
+        rng = random.Random(seed)
+        pid = rng.choice(sorted(pids))
+        epoch = rng.randint(1, max(1, int(last_epoch) - 1))
+        kind = rng.choice(sorted(kinds))
+        return cls(seed=seed, **{kind: [(pid, epoch)]})
+
+    def event_at(self, pid: int, epoch: int):
+        """``"kill"``/``"roll"``/None for worker ``pid`` at the moment
+        epoch ``epoch`` seals — the one hook the soak's worker loop
+        calls."""
+        if self.kill.get(int(pid)) == int(epoch):
+            return "kill"
+        if self.roll.get(int(pid)) == int(epoch):
+            return "roll"
+        return None
+
+    def __repr__(self):
+        parts = []
+        if self.kill:
+            parts.append(f"kill={sorted(self.kill.items())}")
+        if self.roll:
+            parts.append(f"roll={sorted(self.roll.items())}")
+        if self.seed is not None:
+            parts.append(f"seed={self.seed}")
+        return f"HandoffChaos({', '.join(parts)})"

@@ -128,7 +128,7 @@ def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
 def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                    wire=None, metrics=None, events=None,
                    decode_trace: bool = False, resume=None,
-                   resume_epoch: int = None):
+                   resume_epoch: int = None, ckpt_sink=None):
     """Build the full cross-host row data plane for a process: one
     :class:`~windflow_tpu.parallel.channel.RowReceiver` listening at
     ``addresses[my_pid]`` and one hardened
@@ -177,7 +177,16 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     barrier rather than from a seq it no longer remembers, which is
     exactly the wire tail the restored dataflow needs.  Unset (and
     unset on ``wire``) ⇒ the plane behaves byte-identically to before
-    (no journal, no handshake)."""
+    (no journal, no handshake).
+
+    ``ckpt_sink`` (typically a ``recovery.portable.PortableSpool``)
+    opts this process into RECEIVING peers' portable checkpoints (the
+    ``-7`` wire family): each peer's sealed epochs land under the
+    spool, which is what a :class:`~windflow_tpu.parallel.plane.
+    PlaneSupervisor` successor restores a dead peer from
+    (docs/ROBUSTNESS.md "Cross-host recovery").  Unset ⇒ the family is
+    refused on arrival and nothing new is imported — the seed
+    contract."""
     from .channel import RowReceiver, RowSender, WireConfig
     if my_pid not in addresses:
         raise KeyError(f"addresses has no entry for this process "
@@ -195,7 +204,7 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                            metrics=metrics, events=events,
                            decode_trace=decode_trace,
                            resume=resume, resume_epoch=resume_epoch,
-                           wire=wire)
+                           ckpt_sink=ckpt_sink, wire=wire)
     senders = {}
     try:
         for pid in sorted(addresses):

@@ -10,6 +10,13 @@ Layout (under ``RecoveryPolicy.checkpoint_dir``)::
 An epoch directory without a manifest is an incomplete (in-progress or
 crashed) checkpoint and is ignored by :meth:`latest_complete`.  Blobs are
 also written tmp-then-rename so a reader never observes a torn file.
+Each committed blob's manifest entry also records its CRC32
+(``{"bytes": n, "crc": c}``), and :meth:`latest_complete` *verifies*
+the newest sealed epoch against it — a torn or bit-flipped ``.ckpt``
+(filesystem damage after the rename, a partially copied directory)
+makes the restore fall back to the previous sealed epoch (counted as
+``ckpt_fallbacks`` and evented as ``checkpoint_fallback``) instead of
+raising mid-restore.
 Snapshot states may contain lazy handles (e.g. the resident ring's
 device→host copy, ops/resident.RingSnapshot): :func:`resolve_state`
 materialises them just before pickling, on the supervisor's writer
@@ -24,6 +31,7 @@ import pickle
 import re
 import shutil
 import time
+import zlib
 
 _EPOCH_DIR = re.compile(r"^epoch_(\d{6,})$")
 
@@ -49,9 +57,18 @@ class CheckpointStore:
     """Filesystem checkpoint store (one instance per Dataflow run, used
     from the supervisor's writer thread only — no internal locking)."""
 
-    def __init__(self, root: str, retain: int = 2):
+    def __init__(self, root: str, retain: int = 2, metrics=None,
+                 events=None):
         self.root = root
         self.retain = int(retain)
+        #: optional observability hooks (obs.MetricsRegistry / EventLog):
+        #: only the integrity-fallback path uses them, so a bare store
+        #: stays dependency-free
+        self._metrics = metrics
+        self._events = events
+        #: CRC32 of each blob written this run, keyed (epoch, safe_id);
+        #: commit() folds them into the manifest's node meta
+        self._crc: dict = {}
         os.makedirs(root, exist_ok=True)
 
     # ------------------------------------------------------------- writing
@@ -67,11 +84,13 @@ class CheckpointStore:
         os.makedirs(d, exist_ok=True)
         blob = pickle.dumps(resolve_state(state),
                             protocol=pickle.HIGHEST_PROTOCOL)
-        path = os.path.join(d, f"{_safe_id(node_id)}.ckpt")
+        safe = _safe_id(node_id)
+        path = os.path.join(d, f"{safe}.ckpt")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, path)
+        self._crc[(epoch, safe)] = zlib.crc32(blob) & 0xFFFFFFFF
         return len(blob)
 
     def commit(self, epoch: int, nodes: dict, partial: bool = False):
@@ -80,12 +99,22 @@ class CheckpointStore:
         {"bytes": n} (or {"skipped": reason})."""
         d = self._epoch_dir(epoch)
         os.makedirs(d, exist_ok=True)
+        safe_nodes = {}
+        for k, v in nodes.items():
+            safe = _safe_id(k)
+            crc = self._crc.pop((epoch, safe), None)
+            if crc is not None and "bytes" in v:
+                v = dict(v, crc=crc)
+            safe_nodes[safe] = v
         manifest = {"epoch": epoch, "t": time.time(), "partial": partial,
-                    "nodes": {_safe_id(k): v for k, v in nodes.items()}}
+                    "nodes": safe_nodes}
         tmp = os.path.join(d, "MANIFEST.json.tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f)
         os.replace(tmp, os.path.join(d, "MANIFEST.json"))
+        # epochs seal in ascending order: CRCs staged at or below this
+        # epoch but not committed belong to skipped blobs — drop them
+        self._crc = {k: v for k, v in self._crc.items() if k[0] > epoch}
         self._prune()
 
     def _prune(self):
@@ -122,15 +151,57 @@ class CheckpointStore:
                 out.append(int(m.group(1)))
         return sorted(out)
 
+    def verify_epoch(self, epoch: int, manifest: dict) -> str:
+        """Integrity-check a sealed epoch's blobs against the manifest:
+        every non-skipped node's ``.ckpt`` must exist, match its
+        recorded size, and (when the manifest carries one) match its
+        CRC32.  Returns None when clean, else a one-line reason."""
+        d = self._epoch_dir(epoch)
+        for safe, meta in manifest.get("nodes", {}).items():
+            if "bytes" not in meta:
+                continue            # skipped node: no blob expected
+            path = os.path.join(d, f"{safe}.ckpt")
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                return f"{safe}.ckpt unreadable: {type(e).__name__}: {e}"
+            if len(blob) != int(meta["bytes"]):
+                return (f"{safe}.ckpt torn: {len(blob)} bytes on disk, "
+                        f"manifest says {meta['bytes']}")
+            crc = meta.get("crc")
+            if crc is not None \
+                    and (zlib.crc32(blob) & 0xFFFFFFFF) != int(crc):
+                return f"{safe}.ckpt corrupt: CRC32 mismatch"
+        return None
+
     def latest_complete(self):
-        """(epoch, manifest) of the newest sealed checkpoint, or None."""
-        done = self.epochs()
-        if not done:
-            return None
-        epoch = done[-1]
-        with open(os.path.join(self._epoch_dir(epoch),
-                               "MANIFEST.json")) as f:
-            return epoch, json.load(f)
+        """(epoch, manifest) of the newest sealed checkpoint whose blobs
+        VERIFY (size + CRC32 against the manifest), or None.  A torn or
+        corrupt newest epoch falls back to the previous sealed one —
+        counted (``ckpt_fallbacks``) and evented (``checkpoint_fallback``)
+        — instead of raising mid-restore."""
+        for epoch in reversed(self.epochs()):
+            try:
+                with open(os.path.join(self._epoch_dir(epoch),
+                                       "MANIFEST.json")) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError) as e:
+                self._note_fallback(epoch, f"MANIFEST.json unreadable: "
+                                           f"{type(e).__name__}: {e}")
+                continue
+            reason = self.verify_epoch(epoch, manifest)
+            if reason is None:
+                return epoch, manifest
+            self._note_fallback(epoch, reason)
+        return None
+
+    def _note_fallback(self, epoch: int, reason: str):
+        if self._metrics is not None:
+            self._metrics.counter("ckpt_fallbacks").inc()
+        if self._events is not None:
+            self._events.emit("checkpoint_fallback", epoch=epoch,
+                              reason=reason)
 
     def load(self, epoch: int, node_id: str):
         """Unpickle one node's blob from a sealed epoch."""

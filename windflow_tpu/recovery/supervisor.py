@@ -72,7 +72,9 @@ class Supervisor:
         if policy.checkpoint_dir:
             from .store import CheckpointStore
             self.store = CheckpointStore(policy.checkpoint_dir,
-                                         retain=policy.retain)
+                                         retain=policy.retain,
+                                         metrics=dataflow.metrics,
+                                         events=dataflow.events)
             self._wq = queue.Queue()
             self._writer = threading.Thread(
                 target=self._writer_loop, daemon=True,

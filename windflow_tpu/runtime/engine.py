@@ -592,6 +592,28 @@ class Dataflow:
         self._seal_listeners.append(fn)
         return fn
 
+    def request_drain(self, timeout: float = None) -> bool:
+        """Gate every source and wait for the in-flight work to settle
+        (the quiesce leg of a rolling restart, docs/ROBUSTNESS.md
+        "Cross-host recovery").  Requires a running graph with a
+        ``control=`` policy declaring a :class:`~windflow_tpu.control.
+        Drain` rule; returns whether the graph fully quiesced within
+        the deadline.  Pair with :meth:`release_drain`."""
+        if self._controller is None:
+            raise RuntimeError(
+                "request_drain() needs a running dataflow with "
+                "control=ControlPolicy([..., Drain(...)]) — call after "
+                "run() (docs/CONTROL.md)")
+        return self._controller.request_drain(timeout)
+
+    def release_drain(self):
+        """Reopen the source gate closed by :meth:`request_drain`."""
+        if self._controller is None:
+            raise RuntimeError(
+                "release_drain() needs a running dataflow with "
+                "control=ControlPolicy([..., Drain(...)])")
+        self._controller.release_drain()
+
     # ------------------------------------------------------------------ run
 
     def _error_budget_of(self, node: Node) -> int:
