@@ -45,6 +45,9 @@ class _Worker:
     (hard death at a seal, or roll-in-place with ``resume_epoch=``)."""
 
     def __init__(self, pid, peer, root):
+        from windflow_tpu.obs.federation import (FederationPolicy,
+                                                 FederationShipper,
+                                                 TelemetryAggregator)
         from windflow_tpu.parallel.channel import RowReceiver, WireResume
         from windflow_tpu.recovery.portable import PortableSpool
         from windflow_tpu.recovery.store import CheckpointStore
@@ -55,6 +58,17 @@ class _Worker:
         self.recv = RowReceiver(1, resume=WireResume(deadline=30.0),
                                 ack_epochs=False, accept_timeout=30.0)
         self.port = self.recv.port
+        # telemetry federation riding the same monitor links the
+        # portable checkpoints use: each worker ships a per-seal
+        # snapshot to its peer, whose aggregator spools the ring when
+        # the plane declares this worker dead — the black box the soak
+        # asserts after a kill (docs/OBSERVABILITY.md "Federation &
+        # SLOs")
+        fed_pol = FederationPolicy(host=str(pid), period=0.05)
+        self.fed_spool = os.path.join(root, f"fedspool{pid}")
+        self.agg = TelemetryAggregator(fed_pol, spool_dir=self.fed_spool)
+        self.shipper = FederationShipper(fed_pol, host=str(pid),
+                                         dataflow_name=f"w{pid}")
         # a short monitor-link resume deadline: after a peer death,
         # a replicate() that lost the mid-transmit race against the
         # ack reader's EOF detection stalls the survivor's seal loop
@@ -62,7 +76,8 @@ class _Worker:
         # the next seal re-ships — docs/ROBUSTNESS.md)
         self.mon_recv = RowReceiver(1, resume=WireResume(deadline=5.0),
                                     accept_timeout=30.0,
-                                    ckpt_sink=self.spool)
+                                    ckpt_sink=self.spool,
+                                    telemetry_sink=self.agg)
         self.mon_port = self.mon_recv.port
         self.mon_snd = None
         self.sup = None
@@ -84,9 +99,11 @@ class _Worker:
             wire=WireConfig(connect_deadline=10.0, heartbeat=2.0,
                             stall_timeout=30.0, resume=True,
                             recovery=False))
+        self.shipper.bind({self.peer: self.mon_snd})
         self.sup = PlaneSupervisor(
             self.pid, addresses, {self.peer: self.mon_snd}, policy=policy,
-            store=self.store, spool=self.spool, on_adopt=self._on_adopt)
+            store=self.store, spool=self.spool, on_adopt=self._on_adopt,
+            on_death=self.agg.on_death)
         self.sup.start()
 
     def _on_adopt(self, dead, epoch, st):
@@ -132,6 +149,14 @@ class _Worker:
                     self.sealed_rows.extend(pending)
                     pending = []
                     self.sup.replicate(e)
+                    # force-ship one telemetry snapshot per seal (no
+                    # sampler runs here): the kill epoch's snapshot is
+                    # the last thing the victim says, and the
+                    # survivor's black box must hold it
+                    self.shipper.ship({"t": time.time(), "seq": e,
+                                       "dataflow": f"w{self.pid}",
+                                       "nodes": [],
+                                       "counters": {"sealed": e}})
                     self.recv.ack_epoch(e)
                     ev = chaos.event_at(self.pid, e)
                     if ev == "kill":
@@ -287,6 +312,30 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
                 got.setdefault(k, []).append([rid, cum])
         for rows in got.values():
             rows.sort()
+        # the black-box half of the handoff promise: after a kill, the
+        # successor's federation spool must hold the victim's final
+        # telemetry snapshots — including the seal the victim died at
+        # (the aggregator's on_death spooled them when the plane
+        # declared the death, before adoption)
+        for victim, kill_epoch in chaos.kill.items():
+            if workers[victim].fate != "killed":
+                continue
+            import glob as _glob
+            survivor = workers[victim].peer
+            files = _glob.glob(os.path.join(
+                workers[survivor].fed_spool, f"blackbox-{victim}-*.json"))
+            assert files, (
+                f"{repro}: worker {victim} was killed at epoch "
+                f"{kill_epoch} but the survivor's federation spool "
+                f"holds no black box for it (params {params})")
+            import json as _json
+            with open(sorted(files)[-1]) as f:
+                box = _json.load(f)
+            seqs = [s.get("seq") for s in box.get("samples", ())]
+            assert kill_epoch in seqs, (
+                f"{repro}: the spooled black box for worker {victim} "
+                f"misses its final snapshot (seal {kill_epoch}); got "
+                f"seqs {seqs} (params {params})")
         for w in workers.values():
             w.teardown()
 

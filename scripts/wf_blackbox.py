@@ -1,0 +1,125 @@
+"""wf_blackbox — post-mortem timeline of a crash black-box file.
+
+Renders the ``blackbox-<node>-<ts>.json`` flight-recorder dumps the
+federation tier writes (docs/OBSERVABILITY.md "Federation & SLOs"):
+either a node's own dump (on node_error / recovery give-up / plane
+death — event ring + recent spans + last K sampler snapshots) or the
+aggregator's spool of a dead peer's final snapshots.  Everything is
+merged onto one wall-clock timeline, newest last, so the sequence that
+led to the crash reads top to bottom.
+
+    python scripts/wf_blackbox.py /tmp/wf                 # newest dump
+    python scripts/wf_blackbox.py /tmp/wf/blackbox-w1-... # specific file
+    python scripts/wf_blackbox.py /tmp/wf --list          # inventory
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def find_dumps(path):
+    """All black-box files under ``path`` (a dir or one file), newest
+    first."""
+    if os.path.isfile(path):
+        return [path]
+    return sorted(glob.glob(os.path.join(path, "blackbox-*.json")),
+                  key=os.path.getmtime, reverse=True)
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def timeline(doc):
+    """Merge the dump's rings onto one (t, kind, text) list, oldest
+    first.  Pure: testable without files."""
+    rows = []
+    for e in doc.get("events", ()):
+        extra = " ".join(f"{k}={v}" for k, v in e.items()
+                         if k not in ("t", "event"))
+        rows.append((e.get("t", 0.0), "event",
+                     f"{e.get('event', '?'):<18} {extra}"))
+    for s in doc.get("spans", ()):
+        # tracer ring rows (obs/trace.py): per-batch spans with queue
+        # wait + service in microseconds
+        if not isinstance(s, dict):
+            rows.append((0.0, "span", str(s)))
+            continue
+        rows.append((s.get("t", s.get("t0", 0.0)), "span",
+                     f"{s.get('node', '?'):<18} "
+                     f"q={s.get('q_us', s.get('queue_us', 0)):.0f}us "
+                     f"svc={s.get('svc_us', s.get('service_us', 0)):.0f}us"))
+    for rec in doc.get("samples", ()):
+        nodes = rec.get("nodes", [])
+        depth = max((n.get("depth", 0) for n in nodes), default=0)
+        shed = sum(n.get("shed", 0) for n in nodes)
+        rows.append((rec.get("t", 0.0), "sample",
+                     f"seq={rec.get('seq', 0)} nodes={len(nodes)} "
+                     f"max_depth={depth} shed={shed} "
+                     f"dead_letters={rec.get('dead_letters', 0)}"))
+    rows.sort(key=lambda r: r[0])
+    return rows
+
+
+def render(doc, clock=time.localtime):
+    """The full post-mortem report as a string."""
+    who = doc.get("node", doc.get("host", "?"))
+    head = (f"wf_blackbox  {who}  reason={doc.get('reason', '?')}  "
+            f"dumped={time.strftime('%H:%M:%S', clock(doc.get('t', 0)))}")
+    lines = [head]
+    extra = {k: v for k, v in doc.items()
+             if k not in ("v", "node", "host", "t", "reason", "events",
+                          "spans", "samples")}
+    if extra:
+        lines.append("  " + "  ".join(f"{k}={v}"
+                                      for k, v in sorted(extra.items())))
+    lines.append("")
+    rows = timeline(doc)
+    if not rows:
+        lines.append("  (empty rings: nothing was recorded before the "
+                     "dump)")
+    for t, kind, text in rows:
+        lines.append(f"  {time.strftime('%H:%M:%S', clock(t))} "
+                     f"[{kind:<6}] {text}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="trace/spool dir, or one "
+                                 "blackbox-*.json file")
+    ap.add_argument("--list", action="store_true",
+                    help="inventory the dumps instead of rendering one")
+    a = ap.parse_args(argv)
+
+    dumps = find_dumps(a.path)
+    if not dumps:
+        print(f"wf_blackbox: no blackbox-*.json under {a.path}",
+              file=sys.stderr)
+        return 2
+    if a.list:
+        for p in dumps:
+            try:
+                doc = load(p)
+            except (OSError, json.JSONDecodeError):
+                print(f"{p}  (unreadable)")
+                continue
+            print(f"{p}  {doc.get('node', doc.get('host', '?'))}  "
+                  f"reason={doc.get('reason', '?')}  "
+                  f"events={len(doc.get('events', ()))} "
+                  f"spans={len(doc.get('spans', ()))} "
+                  f"samples={len(doc.get('samples', ()))}")
+        return 0
+    print(render(load(dumps[0])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

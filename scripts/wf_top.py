@@ -26,24 +26,46 @@ _COLS = ("NODE", "DEPTH", "HWM", "BATCH/S", "TUPLES/S", "EWMA_US",
 _W = (22, 6, 6, 10, 12, 9, 9, 9, 8, 6)
 
 
+def _parse_lines(f):
+    samples = []
+    offset = f.tell()
+    while True:
+        line = f.readline()
+        if not line:
+            break
+        if not line.endswith("\n"):
+            break   # torn tail: re-read next refresh
+        offset = f.tell()
+        try:
+            samples.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return samples, offset
+
+
 def read_samples(path, offset=0):
     """Parse sample lines appended since ``offset``; returns
     (new_samples, new_offset).  A torn final line (writer mid-append) is
-    left for the next read."""
+    left for the next read.  A file SHORTER than ``offset`` means the
+    sampler rotated it (obs/sampler.py size bound): the unread tail now
+    lives in ``<path>.1`` — drain that from the old offset first, then
+    restart at the new file's head, so following survives the roll."""
     samples = []
+    try:
+        if os.path.getsize(path) < offset:
+            try:
+                with open(path + ".1") as f:
+                    f.seek(offset)
+                    samples.extend(_parse_lines(f)[0])
+            except OSError:
+                pass    # double-rolled between polls: tail is lost
+            offset = 0
+    except OSError:
+        return samples, offset
     with open(path) as f:
         f.seek(offset)
-        while True:
-            line = f.readline()
-            if not line:
-                break
-            if not line.endswith("\n"):
-                break   # torn tail: re-read next refresh
-            offset = f.tell()
-            try:
-                samples.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+        new, offset = _parse_lines(f)
+        samples.extend(new)
     return samples, offset
 
 
@@ -171,6 +193,56 @@ def render(cur, prev, events=(), clock=time.localtime):
     return "\n".join(lines)
 
 
+_PLANE_COLS = ("HOST", "STATE", "AGE_S", "SEQ", "DATAFLOW", "DEPTH",
+               "TUPLES", "SHED", "Q95_US")
+_PLANE_W = (14, 6, 7, 6, 14, 6, 10, 8, 9)
+
+
+def render_plane(state, clock=time.localtime):
+    """One frame of the cluster view (``--plane``) from the aggregator's
+    state file (obs/federation.py ``TelemetryAggregator.write_state``):
+    one row per federated host, the plane-scope SLO signal view, and
+    which objectives are burning.  Pure: testable without a tty."""
+    hosts = state.get("hosts", {})
+    view = state.get("view", {})
+    fresh = sum(1 for h in hosts.values() if h.get("fresh"))
+    head = (f"wf_top --plane  hosts={len(hosts)} fresh={fresh}  "
+            f"t={time.strftime('%H:%M:%S', clock(state.get('t', 0)))}")
+    lines = [head, ""]
+    lines.append("  ".join(c.ljust(w) if i == 0 else c.rjust(w)
+                           for i, (c, w) in enumerate(zip(_PLANE_COLS,
+                                                          _PLANE_W))))
+    for host in sorted(hosts):
+        meta = hosts[host]
+        snap = (state.get("latest") or {}).get(host) or {}
+        nodes = snap.get("nodes", [])
+        row = (host,
+               "ok" if meta.get("fresh") else "STALE",
+               f"{meta.get('age', 0.0):.1f}",
+               str(meta.get("seq", 0)),
+               meta.get("dataflow", ""),
+               str(max((n.get("depth", 0) for n in nodes), default=0)),
+               str(sum(n.get("rcv_tuples", 0) for n in nodes)),
+               str(sum(n.get("shed", 0) for n in nodes)),
+               f"{max((n.get('q_p95_us', 0.0) for n in nodes), default=0.0):.1f}")
+        lines.append("  ".join(c.ljust(w) if i == 0 else c.rjust(w)
+                               for i, (c, w) in enumerate(zip(row,
+                                                              _PLANE_W))))
+    parts = [f"availability={view.get('availability', 1.0):.2f}"]
+    if view.get("q95_us"):
+        parts.append(f"q95_us={view['q95_us']:.1f}")
+    if view.get("shed_rate"):
+        parts.append(f"shed_rate={view['shed_rate']:.1f}/s")
+    if view.get("stale_seconds"):
+        parts.append(f"stale_s={view['stale_seconds']:.1f}")
+    burning = state.get("slo_burning", [])
+    parts.append("slo=BURN[" + ",".join(burning) + "]" if burning
+                 else "slo=ok")
+    lines.append("")
+    lines.append("plane: " + "  ".join(parts))
+    return "\n".join(lines)
+
+
 def tail_events(path, n=6):
     if not os.path.exists(path):
         return []
@@ -198,7 +270,40 @@ def main(argv=None):
                          "exposition and exit")
     ap.add_argument("--events", type=int, default=6,
                     help="event-log tail length (0 disables)")
+    ap.add_argument("--plane", action="store_true",
+                    help="cluster view: render the federation "
+                         "aggregator's state file (federation.json in "
+                         "the given dir) instead of one process's "
+                         "metrics")
     a = ap.parse_args(argv)
+
+    if a.plane:
+        path = a.path
+        if os.path.isdir(path):
+            path = os.path.join(path, "federation.json")
+        if not os.path.exists(path):
+            print(f"wf_top: no federation state at {path} (is a "
+                  f"TelemetryAggregator running with state_path= "
+                  f"set?)", file=sys.stderr)
+            return 2
+        while True:
+            with open(path) as f:
+                try:
+                    state = json.load(f)
+                except json.JSONDecodeError:
+                    state = None    # mid-replace race: retry next tick
+            if state is not None:
+                frame = render_plane(state)
+                if a.once:
+                    print(frame)
+                    return 0
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+            elif a.once:
+                print("wf_top: federation state file is unreadable",
+                      file=sys.stderr)
+                return 2
+            time.sleep(a.interval)
 
     path = a.path
     if os.path.isdir(path):

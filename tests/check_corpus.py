@@ -24,7 +24,7 @@ SCHEMA = Schema(value=np.int64)
 
 #: WF### ids the CLI run over this module must report
 PLANTED = ("WF102", "WF103", "WF204", "WF205", "WF207", "WF208",
-           "WF213", "WF214", "WF216", "WF301")
+           "WF213", "WF214", "WF216", "WF217", "WF301")
 
 #: module-level scan target: heartbeat at/above the stall timeout
 BAD_WIRE = WireConfig(heartbeat=5.0, stall_timeout=2.0)   # -> WF205
@@ -82,6 +82,15 @@ def _trace_pipe() -> MultiPipe:
             .chain_sink(Sink(lambda b: None, vectorized=True)))
 
 
+def _federate_pipe() -> MultiPipe:
+    """WF217: federation with no sampler to feed the shipper."""
+    from windflow_tpu.obs.federation import FederationPolicy
+    return (MultiPipe("corpus_federate",
+                      federate=FederationPolicy(host="corpus"))
+            .add_source(Source(_src, SCHEMA))
+            .chain_sink(Sink(lambda b: None, vectorized=True)))
+
+
 def _race_pipe() -> MultiPipe:
     """WF301: parallel replicas mutating closed-over shared state."""
     counts = [0]
@@ -97,5 +106,5 @@ def _race_pipe() -> MultiPipe:
 
 def wf_check_pipelines():
     return [_window_pipe(), _overload_pipe(), _recovery_pipe(),
-            _trace_pipe(), _race_pipe(), BAD_WIRE, BAD_RESUME_WIRE,
-            BAD_PLANE]
+            _trace_pipe(), _federate_pipe(), _race_pipe(), BAD_WIRE,
+            BAD_RESUME_WIRE, BAD_PLANE]

@@ -200,6 +200,12 @@ def _trace_pipe(trace_dir):
                  trace_dir=trace_dir)
 
 
+def _fed_pipe(t, obs=False):
+    from windflow_tpu.obs.federation import FederationPolicy
+    kw = dict(metrics=True, trace_dir=str(t)) if obs else {}
+    return _pipe(name="fed", federate=FederationPolicy(host="chk"), **kw)
+
+
 _G = 0
 
 
@@ -260,6 +266,8 @@ CORPUS = {
     "WF214": (lambda t: WireConfig(resume=True),
               lambda t: WireConfig(resume=True, recovery=True)),
     "WF215": (lambda t: _native_df(), lambda t: _native_df(abi=True)),
+    "WF217": (lambda t: _fed_pipe(t),
+              lambda t: _fed_pipe(t, obs=True)),
     "WF216": (lambda t: PlanePolicy(wire=WireConfig.hardened()),
               lambda t: PlanePolicy(wire=WireConfig(
                   connect_deadline=60.0, heartbeat=2.0,

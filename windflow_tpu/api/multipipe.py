@@ -87,7 +87,8 @@ class MultiPipe:
     def __init__(self, name: str = "pipe", trace_dir: str = None,
                  capacity: int = 16, overload=None, metrics=None,
                  sample_period: float = None, recovery=None,
-                 check: str = None, control=None, trace=None):
+                 check: str = None, control=None, trace=None,
+                 federate=None):
         self.name = name
         self.trace_dir = trace_dir  # None -> WF_LOG_DIR env (tracing.py)
         #: per-queue chunk capacity (engine Inbox bound): the
@@ -132,6 +133,13 @@ class MultiPipe:
         #: (default) keeps seed-identical behavior and never imports
         #: windflow_tpu.obs.trace.
         self.trace = trace
+        #: obs/federation.FederationPolicy (or True) — the plane-wide
+        #: telemetry tier (docs/OBSERVABILITY.md "Federation & SLOs"):
+        #: snapshot shipping over the row plane, local SLO burn rates,
+        #: and the crash black-box.  Falsy (default) keeps seed-
+        #: identical behavior and never imports windflow_tpu.obs
+        #: .federation / .slo.
+        self.federate = federate
         self._stages: list[tuple[str, object]] = []  # (kind, pattern)
         self._branches: list[MultiPipe] = []
         self._has_source = False
@@ -316,7 +324,8 @@ class MultiPipe:
                       metrics=self._metrics_arg,
                       sample_period=self.sample_period,
                       recovery=self.recovery, check=self.check,
-                      control=self.control, trace=self.trace)
+                      control=self.control, trace=self.trace,
+                      federate=self.federate)
             #: the validator (check/graph.py) anchors window-geometry
             #: diagnostics at pattern construction sites via the
             #: declared stage list — only reachable through this stamp
@@ -507,6 +516,22 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                     f"cannot union MultiPipes with conflicting trace "
                     f"policies ({trace!r} vs {pol!r}): one Dataflow "
                     f"runs one tracer — configure it on the merged pipe")
+    # one process runs one federation shipper: configured federate
+    # policies must agree (or all but one be unset) — normalised
+    # lazily, so a union of unfederated pipes never imports
+    # obs.federation
+    fed_pols = [p.federate for p in pipes if p.federate]
+    federate = fed_pols[0] if fed_pols else None
+    if len(fed_pols) > 1:
+        from ..obs.federation import as_policy as _fed_as_policy
+        first = _fed_as_policy(federate)
+        for pol in fed_pols[1:]:
+            if not first.agrees_with(_fed_as_policy(pol)):
+                raise ValueError(
+                    f"cannot union MultiPipes with conflicting federate "
+                    f"policies ({federate!r} vs {pol!r}): one process "
+                    f"runs one shipper — configure it on the merged "
+                    f"pipe")
     # observability merges like capacity: the merged graph samples at the
     # finest requested cadence, and the first configured registry and
     # trace_dir win (these are additive sinks, not behavior — no conflict
@@ -526,7 +551,7 @@ def union_multipipes(*pipes: MultiPipe, name: str = "union") -> MultiPipe:
                        metrics=registries[0] if registries else None,
                        sample_period=min(periods) if periods else None,
                        recovery=recovery, check=check, control=control,
-                       trace=trace)
+                       trace=trace, federate=federate)
     merged._branches = list(pipes)
     # seal listeners are additive sinks like metrics registries: every
     # operand's hooks fire on the one merged supervisor

@@ -213,6 +213,10 @@ def check_pipe_config(pipe) -> list[Diagnostic]:
     if (getattr(pipe, "trace", None)
             and not (pipe.trace_dir or default_trace_dir())):
         diags.append(_ring_only_trace_diag(pipe.name))
+    if (getattr(pipe, "federate", None)
+            and not _obs_configured(pipe._metrics_arg,
+                                    pipe.sample_period)):
+        diags.append(_blind_federation_diag(f"MultiPipe {pipe.name!r}"))
     return diags
 
 
@@ -232,6 +236,16 @@ def _no_trace_dir_diag(name: str) -> Diagnostic:
         f"trace_dir (trace_dir= or WF_LOG_DIR): the live registry works "
         f"but metrics.jsonl/events.jsonl are never written — set "
         f"trace_dir to keep the telemetry")
+
+
+def _blind_federation_diag(owner: str) -> Diagnostic:
+    return Diagnostic(
+        "WF217",
+        f"{owner}: federate= is set but neither metrics= nor "
+        f"sample_period= is — the federation shipper's only source is "
+        f"the sampler, so no telemetry snapshot is ever shipped and "
+        f"federation is silently inert (set metrics=True; "
+        f"docs/OBSERVABILITY.md \"Federation & SLOs\")")
 
 
 def _ring_only_trace_diag(name: str) -> Diagnostic:
@@ -255,4 +269,6 @@ def check_dataflow_config(df) -> list[Diagnostic]:
         diags.append(_ring_only_trace_diag(df.name))
     if df.control is not None and df.metrics is None:
         diags.append(_blind_control_diag(f"Dataflow {df.name!r}"))
+    if getattr(df, "federate", None) is not None and df.metrics is None:
+        diags.append(_blind_federation_diag(f"Dataflow {df.name!r}"))
     return diags

@@ -91,6 +91,10 @@ CATALOG: dict[str, tuple[str, str]] = {
               "plane supervisor/rolling restart over a wire without "
               "resume=: at handoff the dead process's in-flight frames "
               "have no journal to replay from and are silently lost"),
+    "WF217": (WARNING,
+              "federate= set without metrics=/sample_period=: the "
+              "shipper's only source is the sampler, so no snapshot is "
+              "ever shipped and federation is silently inert"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "

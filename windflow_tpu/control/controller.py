@@ -142,7 +142,22 @@ class Controller:
                     f"p95 signal never populates, so this trigger is "
                     f"inert (docs/OBSERVABILITY.md §tracing)",
                     stacklevel=2)
-            elif isinstance(rule, AdaptiveShed):
+            if (isinstance(rule, Rescale)
+                    and rule.up_slo_burn is not None
+                    and (getattr(df, "federate", None) is None
+                         or df.federate.slo is None)):
+                # the burn signal is the slo_burn_max gauge the local
+                # SloEvaluator publishes; without federate=(slo=...) it
+                # never populates — same inert-signal shape as up_q95_us
+                import warnings
+                warnings.warn(
+                    f"Rescale({rule.pattern!r}): up_slo_burn is set but "
+                    f"the dataflow runs without federate= (or its "
+                    f"FederationPolicy has no slo=) — the slo_burn_max "
+                    f"signal never populates, so this trigger is inert "
+                    f"(docs/OBSERVABILITY.md §Federation & SLOs)",
+                    stacklevel=2)
+            if isinstance(rule, AdaptiveShed):
                 pol = df.overload
                 if pol is None or pol.shed == "block":
                     raise ValueError(
@@ -235,6 +250,12 @@ class Controller:
         most one attribute store per actuator."""
         now = _monotonic()
         nodes = {n["id"]: n for n in rec.get("nodes", ())}
+        # SLO burn signal (obs/slo.py): the local evaluator publishes
+        # slo_burn_max into the registry, and the sampler embeds the
+        # registry snapshot — so the controller reads it one sample
+        # late, which is exactly the cadence lag the burn windows
+        # already smooth over.  0.0 (inert) without federate=(slo=).
+        slo_burn = float(rec.get("gauges", {}).get("slo_burn_max", 0.0))
         for fc in self.farms:
             if fc.busy:
                 continue            # a rescale is already in flight
@@ -248,7 +269,8 @@ class Controller:
             q95_us = max((nodes[i].get("q_p95_us", 0.0)
                           for i in ids[:fc.width] if i in nodes),
                          default=0.0)
-            d = fc.rule.observe((depth, shed_rate, q95_us), now)
+            d = fc.rule.observe((depth, shed_rate, q95_us, slo_burn),
+                                now)
             if d:
                 rule = fc.rule
                 width = fc.width
@@ -259,7 +281,8 @@ class Controller:
                     self._note("rescale_request", fc.pattern.name,
                                target, depth=depth,
                                shed_rate=round(shed_rate, 3),
-                               q95_us=q95_us)
+                               q95_us=q95_us,
+                               slo_burn=round(slo_burn, 3))
         if self.shed_rule is not None:
             self._drive_shed(self._max_depth(nodes), now)
         for adm in self.admissions:

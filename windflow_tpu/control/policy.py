@@ -118,6 +118,15 @@ class Rescale(_ThresholdRule):
     to run ``trace=`` (the controller warns once and the signal stays 0
     otherwise).
 
+    ``up_slo_burn`` closes the loop from the SLO layer (obs/slo.py):
+    the sampler record's ``slo_burn_max`` gauge — the max over
+    objectives of min(fast burn, slow burn), published by the local
+    :class:`~windflow_tpu.obs.slo.SloEvaluator` the federation shipper
+    drives — triggers a grow when it stays at/above the threshold
+    (``1.0`` = burning exactly at budget).  Needs the dataflow to run
+    ``federate=`` with an ``slo=`` policy (the controller warns once
+    and the signal stays 0 otherwise).
+
     Requires ``recovery=`` on the dataflow (epoch barriers are the
     consistent cut the migration seals at — the Dataflow constructor
     refuses the combination otherwise, WF211) and workers whose cores
@@ -127,8 +136,9 @@ class Rescale(_ThresholdRule):
 
     def __init__(self, pattern: str, max_workers: int,
                  min_workers: int = 1, up_depth=None, down_depth=None,
-                 up_shed=None, up_q95_us=None, step: int = 1,
-                 hysteresis: int = 2, cooldown: float = 5.0):
+                 up_shed=None, up_q95_us=None, up_slo_burn=None,
+                 step: int = 1, hysteresis: int = 2,
+                 cooldown: float = 5.0):
         super().__init__(up_depth, down_depth, hysteresis, cooldown)
         if not pattern:
             raise ValueError("Rescale needs the target pattern's name")
@@ -146,24 +156,34 @@ class Rescale(_ThresholdRule):
         if up_q95_us is not None and float(up_q95_us) <= 0:
             raise ValueError("up_q95_us must be a positive queue-wait "
                              "p95 in microseconds")
+        if up_slo_burn is not None and float(up_slo_burn) <= 0:
+            raise ValueError("up_slo_burn must be a positive burn-rate "
+                             "multiple (1.0 = burning exactly at "
+                             "budget)")
         self.pattern = str(pattern)
         self.min_workers = int(min_workers)
         self.max_workers = int(max_workers)
         self.up_shed = None if up_shed is None else float(up_shed)
         self.up_q95_us = None if up_q95_us is None else float(up_q95_us)
+        self.up_slo_burn = (None if up_slo_burn is None
+                            else float(up_slo_burn))
         self.step = int(step)
 
     # the rescale signal is (max worker depth, head shed rate[, max
-    # worker queue-wait p95 µs]); the 2-tuple form stays accepted so
-    # pre-trace callers of the pure observe() path are unchanged
+    # worker queue-wait p95 µs[, slo_burn_max]]); the shorter tuple
+    # forms stay accepted so pre-trace / pre-SLO callers of the pure
+    # observe() path are unchanged
     def _classify(self, value) -> int:
         depth, shed_rate, *rest = value
         q95_us = rest[0] if rest else 0.0
+        slo_burn = rest[1] if len(rest) > 1 else 0.0
         if self.high is not None and depth >= self.high:
             return 1
         if self.up_shed is not None and shed_rate >= self.up_shed:
             return 1
         if self.up_q95_us is not None and q95_us >= self.up_q95_us:
+            return 1
+        if self.up_slo_burn is not None and slo_burn >= self.up_slo_burn:
             return 1
         if self.low is not None and depth <= self.low:
             return -1
@@ -172,14 +192,15 @@ class Rescale(_ThresholdRule):
     def _key(self):
         return ("rescale", self.pattern, self.min_workers,
                 self.max_workers, self.high, self.low, self.up_shed,
-                self.up_q95_us, self.step, self.hysteresis,
-                self.cooldown)
+                self.up_q95_us, self.up_slo_burn, self.step,
+                self.hysteresis, self.cooldown)
 
     def __repr__(self):
         return (f"Rescale({self.pattern!r}, {self.min_workers}.."
                 f"{self.max_workers}, up_depth={self.high}, "
                 f"down_depth={self.low}, up_shed={self.up_shed}, "
-                f"up_q95_us={self.up_q95_us}, step={self.step})")
+                f"up_q95_us={self.up_q95_us}, "
+                f"up_slo_burn={self.up_slo_burn}, step={self.step})")
 
 
 class AdaptiveShed(_ThresholdRule):

@@ -54,6 +54,12 @@ EVENT_KINDS = frozenset({
     # span tracing (obs/trace.py): spans discarded past the trace.jsonl
     # max_spans bound — rate-limited, carries the running drop total
     "trace_drop",
+    # federation & SLOs (obs/federation.py, obs/slo.py,
+    # docs/OBSERVABILITY.md "Federation & SLOs"): burn-rate state
+    # transitions (state burn/ok), a peer's federated snapshots going
+    # stale/fresh at the aggregator, and black-box dumps (the local
+    # flight recorder AND the aggregator's spool of a dead peer)
+    "slo_burn", "fed_peer", "blackbox",
 })
 
 
@@ -63,11 +69,22 @@ class EventLog:
     flushed per event — events are rare, and a crash must not lose the
     events explaining it)."""
 
-    def __init__(self, path: str = None, keep: int = 512):
+    def __init__(self, path: str = None, keep: int = 512,
+                 max_bytes: int = None):
         self.path = path
         self.recent = deque(maxlen=keep)
+        #: optional size bound on the file (ISSUE 19): past it the file
+        #: rolls to ``<path>.1`` (one rotated generation) and a fresh
+        #: file opens.  None (default) = unbounded, the seed behavior.
+        #: Rotation happens BETWEEN events, so the per-event flush
+        #: contract holds: every emitted event is durable in either the
+        #: live file or the rolled one before emit() returns.
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        if self.max_bytes is not None and self.max_bytes < 1:
+            raise ValueError("EventLog max_bytes must be positive")
         self._mu = threading.Lock()
         self._f = None
+        self._written = 0
         self._closed = False
 
     def emit(self, event: str, **fields):
@@ -85,9 +102,19 @@ class EventLog:
                     os.makedirs(os.path.dirname(self.path) or ".",
                                 exist_ok=True)
                     self._f = open(self.path, "a")
-                json.dump(rec, self._f)
-                self._f.write("\n")
+                    self._written = os.path.getsize(self.path)
+                line = json.dumps(rec) + "\n"
+                if (self.max_bytes is not None and self._written
+                        and self._written + len(line) > self.max_bytes):
+                    # roll between events, never mid-line: a reader of
+                    # .1 + live always sees whole JSON records
+                    self._f.close()
+                    os.replace(self.path, self.path + ".1")
+                    self._f = open(self.path, "a")
+                    self._written = 0
+                self._f.write(line)
                 self._f.flush()
+                self._written += len(line)
         return rec
 
     def close(self):

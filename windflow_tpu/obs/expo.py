@@ -57,7 +57,11 @@ _NODE_FIELDS = {
 
 
 def _esc(v) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    # the three escapes of the Prometheus text format's label values:
+    # backslash, double-quote, and line feed (a raw newline would tear
+    # the series line in two and fail the scrape)
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _line(name, labels, value):

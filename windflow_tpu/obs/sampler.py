@@ -32,12 +32,29 @@ from ..utils.tracing import node_stats_name
 class Sampler:
     """Periodic snapshotter for one Dataflow (see module docstring)."""
 
-    def __init__(self, dataflow, period: float):
+    def __init__(self, dataflow, period: float,
+                 max_bytes: int = 64 << 20, keep: int = 2):
         self.df = dataflow
         self.period = float(period)
         if self.period <= 0:
             raise ValueError(f"sample_period must be positive, "
                              f"got {period}")
+        #: size bound on metrics.jsonl (ISSUE 19): past it the file
+        #: rolls to ``metrics.jsonl.1`` (older generations shift up,
+        #: ``keep`` of them retained) — long soaks must not grow the
+        #: file without limit.  ``max_bytes=None`` = unbounded.
+        #: Rotation happens between whole lines, so tailing readers
+        #: (``wf_top.read_samples``) detect the roll by file shrink and
+        #: never see a torn record.
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        if self.max_bytes is not None and self.max_bytes < 1:
+            raise ValueError("Sampler max_bytes must be positive")
+        self.keep = int(keep)
+        if self.keep < 1:
+            raise ValueError("Sampler keep must retain at least one "
+                             "rotated file")
+        self._written = 0
+        self._path = None
         self._stop = threading.Event()
         self._last_shed: dict[str, int] = {}
         self._subs: list = []
@@ -79,16 +96,34 @@ class Sampler:
         f = None
         if self.df.trace_dir:
             os.makedirs(self.df.trace_dir, exist_ok=True)
-            f = open(os.path.join(self.df.trace_dir, "metrics.jsonl"), "a")
+            self._path = os.path.join(self.df.trace_dir, "metrics.jsonl")
+            f = open(self._path, "a")
+            self._written = os.path.getsize(self._path)
         try:
             while True:
-                self._write_sample(f)
+                f = self._write_sample(f)
                 if self._stop.wait(self.period):
                     break
-            self._write_sample(f)   # final: the end-state snapshot
+            f = self._write_sample(f)   # final: the end-state snapshot
         finally:
             if f is not None:
                 f.close()
+
+    def _rotate(self, f):
+        """Roll metrics.jsonl -> .1 (older generations shift up, keep-N
+        bounded) and return a fresh handle.  Runs on the sampler thread
+        between whole lines."""
+        f.close()
+        last = f"{self._path}.{self.keep}"
+        if os.path.exists(last):
+            os.remove(last)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self._path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self._path}.{i + 1}")
+        os.replace(self._path, f"{self._path}.1")
+        self._written = 0
+        return open(self._path, "a")
 
     # ------------------------------------------------------------- sampling
 
@@ -185,6 +220,11 @@ class Sampler:
                         f"failures only count sampler_subscriber_errors)",
                         stacklevel=2)
         if f is not None:
-            json.dump(rec, f)
-            f.write("\n")
+            line = json.dumps(rec) + "\n"
+            if (self.max_bytes is not None and self._written
+                    and self._written + len(line) > self.max_bytes):
+                f = self._rotate(f)
+            f.write(line)
             f.flush()
+            self._written += len(line)
+        return f

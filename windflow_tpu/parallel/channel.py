@@ -99,6 +99,11 @@ _CK_BLOB = 2           # sender->receiver: length-prefixed JSON envelope
 #                        blob of exactly `bytes` bytes
 _CK_COMMIT = 3         # sender->receiver: length-prefixed JSON {origin,
 #                        epoch} — every blob shipped, the spool may seal
+_TELEMETRY_FRAME = -8  # federated-telemetry snapshot
+#                        (docs/OBSERVABILITY.md "Federation & SLOs"): one
+#                        length-prefixed JSON snapshot follows — periodic,
+#                        idempotent, never journaled (the next snapshot
+#                        supersedes a lost one)
 
 
 def _send_resume_frame(sock, sub: int, payload: dict):
@@ -315,7 +320,8 @@ class _WireTelemetry:
                  "heartbeats_recv", "heartbeat_misses", "traces_sent",
                  "traces_recv", "resumes", "replayed_frames", "acks_sent",
                  "acks_recv", "journal_depth", "ckpt_shipped_bytes",
-                 "ckpt_fetched_bytes")
+                 "ckpt_fetched_bytes", "fed_shipped_bytes",
+                 "fed_fetched_bytes")
 
     def __init__(self, metrics, events=None):
         self.events = events
@@ -339,6 +345,9 @@ class _WireTelemetry:
         # portable checkpoints (docs/ROBUSTNESS.md "Cross-host recovery")
         self.ckpt_shipped_bytes = c("ckpt_shipped_bytes")
         self.ckpt_fetched_bytes = c("ckpt_fetched_bytes")
+        # federated telemetry (docs/OBSERVABILITY.md "Federation & SLOs")
+        self.fed_shipped_bytes = c("fed_shipped_bytes")
+        self.fed_fetched_bytes = c("fed_fetched_bytes")
 
     def emit(self, event: str, **fields):
         if self.events is not None:
@@ -957,6 +966,46 @@ class RowSender:
             tm.ckpt_shipped_bytes.inc(total)
         return total
 
+    def send_telemetry(self, snap: dict) -> int:
+        """Ship one federated-telemetry snapshot (``-8`` family,
+        docs/OBSERVABILITY.md "Federation & SLOs").  The receiving side
+        must run a ``telemetry_sink=`` (typically an
+        ``obs.federation.TelemetryAggregator``).
+
+        Telemetry frames are NOT journaled — shipping is periodic and
+        lossy-tolerant (the next snapshot supersedes a lost one), so on
+        a resumable link a mid-ship failure gets one resume cycle and a
+        clean retransmit; past that — or on a plain link — the failure
+        raises and the shipper simply tries again at its next period.
+        Like every hardening knob: never sent unless the application
+        calls it, so the bytes on the wire stay seed-identical
+        otherwise."""
+        js = json.dumps(snap).encode("utf-8")
+        with self._send_lock:
+            if self._resume is not None:
+                if self._link_down or self._hb_error is not None:
+                    self._resume_cycle(self._hb_error or ConnectionError(
+                        "row channel link marked down by the ack reader"))
+                try:
+                    return self._transmit_telemetry(js)
+                except OSError as e:
+                    self._resume_cycle(e)
+                    return self._transmit_telemetry(js)
+            self._check_alive()
+            return self._transmit_telemetry(js)
+
+    def _transmit_telemetry(self, js: bytes) -> int:
+        """Write one ``-8`` frame on the current connection.  Caller
+        holds _send_lock."""
+        frame = _LEN.pack(_TELEMETRY_FRAME) + _LEN.pack(len(js)) + js
+        self._sock.sendall(frame)
+        self._last_send = time.monotonic()
+        if self._tm is not None:
+            self._tm.frames_sent.inc()
+            self._tm.bytes_sent.inc(len(frame))
+            self._tm.fed_shipped_bytes.inc(len(frame))
+        return len(frame)
+
     def close(self):
         """Signal EOS (empty frame) and close the socket.  If the EOS
         frame cannot be delivered (peer already dead) the failure is
@@ -1054,7 +1103,8 @@ class RowReceiver:
                  stall_timeout: float = None, accept_timeout: float = None,
                  metrics=None, events=None, decode_trace: bool = False,
                  resume=None, resume_epoch: int = None, ack_epochs=None,
-                 ckpt_sink=None, wire: WireConfig = None):
+                 ckpt_sink=None, telemetry_sink=None,
+                 wire: WireConfig = None):
         if wire is not None:
             wire.validate()
             if stall_timeout is None:
@@ -1080,6 +1130,11 @@ class RowReceiver:
         #: checkpoints at an unconfigured receiver is a deployment
         #: error, not a silent drop.
         self._ckpt_sink = ckpt_sink
+        #: opt-in federated-telemetry landing zone (``-8`` family): an
+        #: object with accept(snapshot_dict) — typically
+        #: ``obs.federation.TelemetryAggregator``.  Same contract as
+        #: ``ckpt_sink``: None (the default) REFUSES the family loudly.
+        self._telemetry_sink = telemetry_sink
         self.n_senders = int(n_senders)
         self.stall_timeout = stall_timeout
         #: bound on the ACCEPT phase: how long to wait for all senders to
@@ -1373,6 +1428,9 @@ class RowReceiver:
             if n == _CKPT_FRAME:
                 self._ckpt_frame(conn)
                 continue
+            if n == _TELEMETRY_FRAME:
+                self._telemetry_frame(conn)
+                continue
             if n == _ABORT_FRAME:
                 if tm is not None:
                     tm.emit("peer_abort", role="receiver")
@@ -1504,6 +1562,28 @@ class RowReceiver:
         else:
             sink.commit(meta)
 
+    def _telemetry_frame(self, conn: socket.socket):
+        """Consume one federated-telemetry frame (``-8`` family,
+        docs/OBSERVABILITY.md "Federation & SLOs") and hand the decoded
+        snapshot to the configured ``telemetry_sink``.  Runs inline on
+        the connection's read thread — a sink failure surfaces exactly
+        like a torn frame, through the read loop's error path."""
+        n = _LEN.unpack(_read_exact(conn, _LEN.size))[0]
+        if not 0 <= n <= (1 << 20):
+            raise ChannelError(f"bad telemetry-frame payload length {n}")
+        raw = _read_exact(conn, n)
+        sink = self._telemetry_sink
+        if sink is None:
+            raise ChannelError(
+                "telemetry frame received but this receiver has no "
+                "telemetry_sink= (give it an obs.federation."
+                "TelemetryAggregator, or stop the peer's federation "
+                "shipping)")
+        if self._tm is not None:
+            self._tm.frames_recv.inc()
+            self._tm.fed_fetched_bytes.inc(2 * _LEN.size + len(raw))
+        sink.accept(json.loads(raw.decode("utf-8")))
+
     def _next_frame(self, conn: socket.socket):
         """One payload frame as ``(frame, trace_or_None)`` — ``frame``
         is bytes, an :class:`EpochMarker`, or None on clean EOS.
@@ -1550,6 +1630,9 @@ class RowReceiver:
                 continue
             if n == _CKPT_FRAME:
                 self._ckpt_frame(conn)
+                continue
+            if n == _TELEMETRY_FRAME:
+                self._telemetry_frame(conn)
                 continue
             if n == _ABORT_FRAME:
                 if tm is not None:

@@ -128,7 +128,8 @@ def process_for_keys(keys: np.ndarray, mesh: Mesh, process_of=None,
 def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                    wire=None, metrics=None, events=None,
                    decode_trace: bool = False, resume=None,
-                   resume_epoch: int = None, ckpt_sink=None):
+                   resume_epoch: int = None, ckpt_sink=None,
+                   telemetry_sink=None):
     """Build the full cross-host row data plane for a process: one
     :class:`~windflow_tpu.parallel.channel.RowReceiver` listening at
     ``addresses[my_pid]`` and one hardened
@@ -186,7 +187,14 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
     PlaneSupervisor` successor restores a dead peer from
     (docs/ROBUSTNESS.md "Cross-host recovery").  Unset ⇒ the family is
     refused on arrival and nothing new is imported — the seed
-    contract."""
+    contract.
+
+    ``telemetry_sink`` (typically an ``obs.federation.
+    TelemetryAggregator``) opts this process into RECEIVING peers'
+    federated-telemetry snapshots (the ``-8`` wire family,
+    docs/OBSERVABILITY.md "Federation & SLOs").  Same contract as
+    ``ckpt_sink``: unset ⇒ the family is refused on arrival and nothing
+    new is imported."""
     from .channel import RowReceiver, RowSender, WireConfig
     if my_pid not in addresses:
         raise KeyError(f"addresses has no entry for this process "
@@ -204,7 +212,8 @@ def open_row_plane(my_pid: int, addresses: dict, capacity: int = 64,
                            metrics=metrics, events=events,
                            decode_trace=decode_trace,
                            resume=resume, resume_epoch=resume_epoch,
-                           ckpt_sink=ckpt_sink, wire=wire)
+                           ckpt_sink=ckpt_sink,
+                           telemetry_sink=telemetry_sink, wire=wire)
     senders = {}
     try:
         for pid in sorted(addresses):

@@ -98,7 +98,8 @@ class PlaneSupervisor:
 
     def __init__(self, my_pid: int, addresses: dict, senders: dict,
                  policy: PlanePolicy = None, store=None, spool=None,
-                 metrics=None, events=None, on_adopt=None):
+                 metrics=None, events=None, on_adopt=None,
+                 on_death=None):
         self.policy = (policy or PlanePolicy()).validate()
         self.my_pid = int(my_pid)
         self.addresses = dict(addresses)
@@ -106,6 +107,12 @@ class PlaneSupervisor:
         self.store = store
         self.spool = spool
         self.on_adopt = on_adopt
+        #: observability hook, called as ``on_death(pid, down_for)`` on
+        #: EVERY death declaration (not just when this process adopts) —
+        #: the federation layer's black-box trigger
+        #: (docs/OBSERVABILITY.md "Federation & SLOs"); failures are
+        #: swallowed so a telemetry bug cannot block the handoff path
+        self.on_death = on_death
         self._metrics = metrics
         self._events = events
         self._down_since: dict[int, float] = {}
@@ -224,6 +231,11 @@ class PlaneSupervisor:
         successor = self.successor_for(pid)
         self._event("membership", peer=pid, state="dead",
                     down_for=round(down_for, 3), successor=successor)
+        if self.on_death is not None:
+            try:
+                self.on_death(pid, down_for)
+            except Exception:  # noqa: BLE001 — telemetry must not
+                pass           # block the handoff path
         if successor == self.my_pid:
             self._adopt(pid)
 
@@ -332,7 +344,8 @@ def open_supervised_plane(my_pid: int, addresses: dict,
                           policy: PlanePolicy = None, spool_dir=None,
                           store=None, capacity: int = 64, metrics=None,
                           events=None, on_adopt=None,
-                          resume_epoch: int = None):
+                          resume_epoch: int = None, telemetry_sink=None,
+                          on_death=None):
     """One-call supervised plane: ``open_row_plane`` with a hardened
     RESUMABLE wire (the supervisor's handoff promise needs journals —
     WF216), a :class:`~windflow_tpu.recovery.portable.PortableSpool`
@@ -352,8 +365,9 @@ def open_supervised_plane(my_pid: int, addresses: dict,
     receiver, senders = open_row_plane(
         my_pid, addresses, capacity=capacity, wire=policy.wire,
         metrics=metrics, events=events, resume_epoch=resume_epoch,
-        ckpt_sink=spool)
+        ckpt_sink=spool, telemetry_sink=telemetry_sink)
     sup = PlaneSupervisor(my_pid, addresses, senders, policy=policy,
                           store=store, spool=spool, metrics=metrics,
-                          events=events, on_adopt=on_adopt).start()
+                          events=events, on_adopt=on_adopt,
+                          on_death=on_death).start()
     return receiver, senders, sup

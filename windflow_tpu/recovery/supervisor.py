@@ -163,6 +163,13 @@ class Supervisor:
     def _giveup(self, node, rec, error, reason: str):
         self._event("recovery_giveup", node=rec.node_id, reason=reason,
                     error=type(error).__name__, message=str(error))
+        bb = getattr(self.dataflow, "_blackbox", None)
+        if bb is not None:
+            # budget exhaustion is a flight-recorder trigger
+            # (docs/OBSERVABILITY.md "Federation & SLOs"): the rings
+            # still hold every restart attempt that led here
+            bb.dump("recovery_giveup", failed_node=rec.node_id,
+                    reason=reason, error=type(error).__name__)
 
     def note_restored(self, node, rec: NodeRecovery, replayed: int,
                       duration_s: float):

@@ -368,7 +368,7 @@ class Dataflow:
                  trace_dir: str = None, overload: OverloadPolicy = None,
                  metrics=None, sample_period: float = None,
                  recovery=None, check: str = None, control=None,
-                 trace=None):
+                 trace=None, federate=None):
         # bounded inboxes give natural backpressure (FastFlow's
         # FF_BOUNDED_BUFFER, the yahoo Makefile default): a source cannot
         # run unboundedly ahead of a slow consumer, keeping queue latency
@@ -518,6 +518,39 @@ class Dataflow:
             self.trace = None
             self.tracer = None
             self._Stamped = None
+        # `federate` (obs/federation.FederationPolicy, or True; any
+        # falsy value = OFF) opts the process into the plane-wide
+        # telemetry tier (docs/OBSERVABILITY.md "Federation & SLOs"): a
+        # shipper rides the sampler and ships compact snapshots over
+        # the row plane's -8 frames (once the app binds the plane's
+        # senders, `df.federation.bind(senders)`), local SLO objectives
+        # evaluate per sample, and the black-box flight recorder dumps
+        # the bounded in-memory rings on node_error / recovery give-up.
+        # Unset means obs.federation / obs.slo are never imported and
+        # no -8 frame is ever sent — the same contract as trace=.
+        if federate:
+            from ..obs.federation import as_policy as _fed_as_policy
+            self.federate = _fed_as_policy(federate)
+            if self.metrics is None:
+                # the shipper's only source is the sampler: with
+                # neither metrics= nor sample_period= no snapshot is
+                # ever built and the whole tier is silently inert —
+                # the WF209 shape of silent no-op, warned once here
+                # and reported by check/ as WF217 (docs/CHECKS.md)
+                import warnings
+                warnings.warn(
+                    f"[WF217] Dataflow {name!r}: federate= is set but "
+                    f"neither metrics= nor sample_period= is — the "
+                    f"shipper's only source is the sampler, so nothing "
+                    f"is ever shipped and federation is inert",
+                    stacklevel=2)
+        else:
+            self.federate = None
+        #: the live FederationShipper (built in run() when federate=
+        #: and the sampler both exist); apps bind the row plane with
+        #: ``df.federation.bind(senders)``
+        self.federation = None
+        self._blackbox = None
         if control is not None and self.metrics is None:
             # the controller's only sensor is the sampler (obs/sampler.py
             # subscription); with neither metrics= nor sample_period= it
@@ -789,6 +822,13 @@ class Dataflow:
                 events.emit("node_error", dataflow=self.name,
                             node=node.name, error=type(e).__name__,
                             message=str(e))
+            if self._blackbox is not None:
+                # flight recorder (docs/OBSERVABILITY.md "Federation &
+                # SLOs"): dump the bounded rings while they still hold
+                # the moments before the failure
+                self._blackbox.dump("node_error", failed_node=node.name,
+                                    error=type(e).__name__,
+                                    message=str(e))
             for inbox in self._inboxes.values():
                 inbox.cancel()  # native rings wake instantly
         finally:
@@ -1152,11 +1192,31 @@ class Dataflow:
             # control without an explicit cadence: the sampler is the
             # controller's sensor bus, so run it at the policy's period
             period = self.control.period
+        if (period is None and self.federate is not None
+                and self.metrics is not None):
+            # federation without an explicit cadence: the shipper rides
+            # the sampler, so run it at the ship period
+            period = self.federate.period
         if period is not None and self._sampler is None:
             from ..obs.sampler import Sampler
             self._sampler = Sampler(self, period)
             if self._controller is not None:
                 self._sampler.subscribe(self._controller.on_sample)
+            if self.federate is not None and self.metrics is not None:
+                # the plane-wide telemetry tier (docs/OBSERVABILITY.md
+                # "Federation & SLOs"): the shipper rides the sampler
+                # like the controller does; the app binds the row
+                # plane's senders with df.federation.bind(senders)
+                from ..obs.federation import BlackBox, FederationShipper
+                self.federation = FederationShipper(
+                    self.federate, host=self.federate.host or self.name,
+                    dataflow_name=self.name, metrics=self.metrics,
+                    events=self.events)
+                self._sampler.subscribe(self.federation.on_sample)
+                if self.federate.blackbox:
+                    self._blackbox = BlackBox(
+                        self.trace_dir, self.name, events=self.events,
+                        tracer=self.tracer, shipper=self.federation)
             self._sampler.start()
 
     def wait(self, timeout: float = None):
