@@ -239,6 +239,29 @@ def run_case_native(seed: int, case: int, verbose: bool = False) -> dict:
     return params
 
 
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): a
+    tiny never-run instance of the crash-recovery topology — source ->
+    window farm -> sink under a RecoveryPolicy.  The sink opts into
+    restart (its real body is an idempotent list append)."""
+    from windflow_tpu import (RecoveryPolicy, Reducer, Sink, Source,
+                              WinFarm)
+    from windflow_tpu.core.windows import WinType
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+
+    sink = Sink(lambda r: None, name="sink")
+    sink.recoverable = True
+    df = Dataflow("soak_crash_lint", capacity=8,
+                  recovery=RecoveryPolicy(epoch_batches=4))
+    build_pipeline(df, [
+        Source(batches=lambda i: iter(()), name="src"),
+        WinFarm(Reducer("sum", "value"), 8, 4, WinType.CB, pardegree=2,
+                name="w"),
+        sink])
+    return [df]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=100, help="number of cases")

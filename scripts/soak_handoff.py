@@ -351,6 +351,34 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
     return params
 
 
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): the
+    soak's supervised feeder + 2-worker row plane, declared as a
+    check.plane.PlaneSpec (WF22x cross-host lint) plus the per-process
+    wire bundle the workers run."""
+    from windflow_tpu.check.plane import HostSpec, PlaneSpec
+    from windflow_tpu.parallel.channel import WireConfig
+    from windflow_tpu.parallel.plane import PlanePolicy
+
+    wire = WireConfig(connect_deadline=30.0, heartbeat=2.0,
+                      stall_timeout=10.0, resume=True, recovery=True)
+    hosts = [
+        # pid 0: the feeder — supervises the plane and federates its
+        # telemetry; pids 1-2: the workers, each a portable-spool
+        # replica target for its peer's takeover
+        HostSpec(0, sends="row", resume=True,
+                 plane=PlanePolicy(wire=wire), federate=True),
+        HostSpec(1, sends="row", resume=True, ckpt_sink=True,
+                 federate=True, aggregator=True),
+        HostSpec(2, sends="row", resume=True, ckpt_sink=True,
+                 federate=True),
+    ]
+    spec = PlaneSpec({0: ("127.0.0.1", 9100), 1: ("127.0.0.1", 9101),
+                      2: ("127.0.0.1", 9102)}, hosts,
+                     name="soak_handoff", wire=wire)
+    return [spec, wire]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=30, help="number of cases")

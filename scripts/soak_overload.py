@@ -161,6 +161,27 @@ def run_soak(n: int, seed: int, verbose: bool = False,
     return stats
 
 
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): a
+    tiny never-run instance of the soak topology — fast source ->
+    poison map -> slow sink under a shedding OverloadPolicy."""
+    from windflow_tpu.core.tuples import Schema
+    from windflow_tpu.patterns.basic import Map, Sink, Source
+    from windflow_tpu.runtime.engine import Dataflow
+    from windflow_tpu.runtime.farm import build_pipeline
+    from windflow_tpu.runtime.overload import OverloadPolicy
+
+    schema = Schema(value=np.int64)
+    df = Dataflow("soak_overload_lint", capacity=4,
+                  overload=OverloadPolicy(shed="shed_oldest",
+                                          error_budget=2))
+    build_pipeline(df, [
+        Source(batches=[], schema=schema),
+        Map(lambda b: None, name="poison_map", vectorized=True),
+        Sink(lambda rows: None, vectorized=True)])
+    return [df]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--n", type=int, default=200, help="number of cases")

@@ -166,6 +166,36 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
     return params
 
 
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): a
+    tiny never-run instance of the rescale topology — keyed farm under
+    a ControlPolicy + RecoveryPolicy with metrics on.  Unlike the soak
+    cases (which run metrics trace-less on purpose and filter WF207),
+    the lint twin supplies a trace_dir so it validates clean."""
+    import tempfile
+
+    from windflow_tpu import (KeyFarm, MultiPipe, RecoveryPolicy,
+                              Reducer, Sink, Source)
+    from windflow_tpu.control import ControlPolicy, Rescale
+    from windflow_tpu.core.tuples import Schema
+    from windflow_tpu.core.windows import WinType
+
+    schema = Schema(value=np.int64)
+    pipe = MultiPipe("soak_rescale_lint", capacity=8,
+                     recovery=RecoveryPolicy(epoch_batches=4),
+                     metrics=True, trace_dir=tempfile.gettempdir(),
+                     control=ControlPolicy(
+                         [Rescale("kf", max_workers=4, min_workers=1)]))
+    pipe.add_source(Source(batches=lambda i: iter(()), schema=schema,
+                           name="src"))
+    pipe.add(KeyFarm(Reducer("sum", "value"), 8, 4, WinType.CB,
+                     pardegree=2, name="kf"))
+    sink = Sink(lambda r: None, name="sink")
+    sink.recoverable = True
+    pipe.add_sink(sink)
+    return [pipe]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=100, help="number of cases")

@@ -112,6 +112,15 @@ def run_case(seed: int, case: int, verbose: bool = False) -> dict:
     return params
 
 
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): the
+    wire bundle the chaos cases run — heartbeat paired with a stall
+    timeout, resume journaling paired with receiver epoch tracking."""
+    from windflow_tpu.parallel.channel import WireConfig
+    return [WireConfig(connect_deadline=10.0, heartbeat=2.0,
+                       stall_timeout=10.0, resume=True, recovery=True)]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=50, help="number of cases")

@@ -234,6 +234,29 @@ def run_roll(root, n_epochs=8, verbose=False):
             "epochs_sealed": state["epoch"]}
 
 
+def wf_check_pipelines():
+    """Static-analysis entry (scripts/wf_lint.py, docs/CHECKS.md): a
+    tiny never-run instance of the feeder MultiPipe the roll sequencer
+    drives (Drain-controlled source -> shipping sink), with a trace_dir
+    so the metrics knob validates clean."""
+    import tempfile
+
+    from windflow_tpu.api import MultiPipe
+    from windflow_tpu.control import ControlPolicy, Drain
+    from windflow_tpu.core.tuples import Schema
+    from windflow_tpu.patterns.basic import Sink, Source
+
+    schema = Schema(value=np.int64)
+    pipe = MultiPipe("wf_roll_feeder", capacity=8, metrics=True,
+                     trace_dir=tempfile.gettempdir(),
+                     control=ControlPolicy([Drain(deadline=60.0,
+                                                  poll=0.01)],
+                                           period=0.05))
+    pipe.add_source(Source(batches=[], schema=schema, name="src"))
+    pipe.add_sink(Sink(lambda rows: None, vectorized=True, name="ship"))
+    return [pipe]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--epochs", type=int, default=8)

@@ -1,0 +1,98 @@
+"""Native sanitizer lane (docs/CHECKS.md "Native sanitizer lane"):
+build the seeded stress driver under ThreadSanitizer / AddressSanitizer
+and run the corpus; any sanitizer report or stress assertion fails the
+lane.
+
+TSan cannot be injected into an uninstrumented CPython via dlopen, so
+the lane does NOT load libwfnative.so — ``native/Makefile``'s ``tsan`` /
+``asan`` targets link ``wf_native.cpp`` straight into the standalone
+``native/wf_stress.cpp`` driver (queue MPMC conservation, the parked-
+producer close/free race, and concurrent state-ABI round trips; see the
+driver's header comment for the phase list).
+
+    python scripts/wf_sanitize.py                      # tsan, 4 cases
+    python scripts/wf_sanitize.py --san both --n 8
+    python scripts/wf_sanitize.py --san asan --seed 7
+
+Exit 0 when every requested lane builds and runs clean; 1 otherwise.
+The same lanes run in-suite (slow-marked) via tests/test_sanitize.py.
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+#: substrings whose presence in the stress output fails the lane even if
+#: the binary somehow exited 0 (sanitizers can be configured not to halt)
+_REPORT_MARKS = ("WARNING: ThreadSanitizer", "ERROR: ThreadSanitizer",
+                 "ERROR: AddressSanitizer", "ERROR: LeakSanitizer",
+                 "runtime error:", "wf_stress FAILED")
+
+_LANES = {"tsan": "wf_stress_tsan", "asan": "wf_stress_asan"}
+
+
+def run_lane(san, seed, n, verbose=False):
+    """Build one sanitizer target and run the seeded corpus; returns
+    (ok, detail)."""
+    binary = _LANES[san]
+    mk = subprocess.run(["make", "-C", NATIVE_DIR, san],
+                        capture_output=True, text=True)
+    if mk.returncode != 0:
+        return False, f"build failed:\n{mk.stdout}{mk.stderr}"
+    env = dict(os.environ)
+    # halt_on_error=0: collect EVERY report in one pass instead of dying
+    # at the first — _REPORT_MARKS scanning catches them regardless
+    env.setdefault("TSAN_OPTIONS", "halt_on_error=0 history_size=7")
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=1")
+    proc = subprocess.run(
+        [os.path.join(NATIVE_DIR, binary), "--seed", str(seed),
+         "--n", str(n)],
+        capture_output=True, text=True, env=env, timeout=900)
+    out = proc.stdout + proc.stderr
+    if verbose:
+        sys.stderr.write(out)
+    hits = [m for m in _REPORT_MARKS if m in out]
+    if proc.returncode != 0 or hits:
+        tail = "\n".join(out.splitlines()[-40:])
+        return False, (f"rc={proc.returncode} reports={hits or 'none'}\n"
+                       f"{tail}")
+    return True, f"clean ({n} cases, seed={seed})"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="build + run the native sanitizer stress lane")
+    ap.add_argument("--san", choices=("tsan", "asan", "both"),
+                    default="tsan")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--n", type=int, default=4,
+                    help="seeded stress cases per lane")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="echo the stress driver's output")
+    args = ap.parse_args(argv)
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        print("wf_sanitize: no native toolchain (make/g++); nothing run",
+              file=sys.stderr)
+        return 1
+
+    lanes = ("tsan", "asan") if args.san == "both" else (args.san,)
+    failed = False
+    for san in lanes:
+        ok, detail = run_lane(san, args.seed, args.n,
+                              verbose=args.verbose)
+        print(f"wf_sanitize [{san}]: {'OK' if ok else 'FAILED'} "
+              f"— {detail.splitlines()[0]}")
+        if not ok:
+            failed = True
+            sys.stderr.write(detail + "\n")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,11 +13,13 @@
   (tests/check_corpus.py) and over the bench apps.
 """
 
+import json
 import os
 import subprocess
 import sys
 import textwrap
 import threading
+import time
 import warnings
 
 import numpy as np
@@ -220,6 +222,81 @@ def _global_pipe(bad):
     return _pipe(Map(fn, parallelism=2, vectorized=True))
 
 
+def _plane(flaw=None):
+    """WF22x corpus: a declared 2-host plane (check/plane.py), clean by
+    construction; ``flaw`` plants exactly one defect."""
+    from windflow_tpu.check.plane import HostSpec, PlaneSpec
+    wire = WireConfig(connect_deadline=30.0, heartbeat=2.0,
+                      stall_timeout=10.0, resume=True, recovery=True)
+    addresses = {0: ("10.0.0.1", 9000), 1: ("10.0.0.2", 9000)}
+    if flaw == "orphan":
+        addresses[2] = ("10.0.0.3", 9000)
+    h0 = HostSpec(0, sends="<i8", resume=True,
+                  plane=PlanePolicy(wire=wire), federate=True)
+    h1 = HostSpec(1, sends="<i8",
+                  expects="<f8" if flaw == "dtype" else None,
+                  resume=None if flaw == "resume" else True,
+                  ckpt_sink=None if flaw == "nosink" else True,
+                  aggregator=flaw != "noagg")
+    return PlaneSpec(addresses, [h0, h1], name="pl", wire=wire)
+
+
+def _replay_pipe(kind):
+    """WF303/WF304 corpus: a recoverable Map under recovery= whose fn
+    commits (or avoids) the flagged effect."""
+    if kind == "time":
+        def fn(b):
+            if b is not None:
+                b["ts"][:] = int(time.time())
+            return b
+    elif kind == "rng":
+        rng = np.random.default_rng(7)
+
+        def fn(b):
+            if b is not None:
+                b["value"][:] = rng.integers(0, 10, len(b))
+            return b
+    elif kind == "file":
+        def fn(b):
+            open(os.devnull, "a").close()
+            return b
+    else:
+        def fn(b):
+            return b
+    s = _sink()
+    s.recoverable = True
+    p = MultiPipe("eff", recovery=RecoveryPolicy())
+    p.add_source(Source(_src, SCHEMA))
+    p.add(Map(fn, vectorized=True))
+    p.add_sink(s)
+    return p
+
+
+def _latency_pipe(t, blocking, latency=True):
+    """WF305 corpus: a keyed farm whose window fn does (or does not)
+    block, under a Rescale rule that is (or is not) latency-triggered."""
+    if blocking:
+        def wfn(key, gwid, rows):
+            time.sleep(0.001)
+            return {"value": rows["value"].sum()}
+    else:
+        def wfn(key, gwid, rows):
+            return {"value": rows["value"].sum()}
+    rule = (Rescale("kf", max_workers=4, up_q95_us=5000.0) if latency
+            else Rescale("kf", max_workers=4))
+    kf = KeyFarm(wfn, win_len=8, slide_len=4, pardegree=2, name="kf",
+                 result_fields=_win_fields())
+    s = _sink()
+    s.recoverable = True
+    p = MultiPipe("lat", control=ControlPolicy([rule]),
+                  recovery=RecoveryPolicy(), metrics=True,
+                  trace_dir=str(t))
+    p.add_source(Source(_src, SCHEMA))
+    p.add(kf)
+    p.add_sink(s)
+    return p
+
+
 #: WF### -> (bad_factory, good_factory); factories take tmp_path.
 #: Every bad graph must report exactly its id (subset check: the id is
 #: present); every good twin must validate with ZERO diagnostics.
@@ -272,10 +349,21 @@ CORPUS = {
               lambda t: PlanePolicy(wire=WireConfig(
                   connect_deadline=60.0, heartbeat=2.0,
                   stall_timeout=10.0, resume=True, recovery=True))),
+    "WF220": (lambda t: _plane("orphan"), lambda t: _plane()),
+    "WF221": (lambda t: _plane("dtype"), lambda t: _plane()),
+    "WF222": (lambda t: _plane("resume"), lambda t: _plane()),
+    "WF223": (lambda t: _plane("nosink"), lambda t: _plane()),
+    "WF224": (lambda t: _plane("noagg"), lambda t: _plane()),
     "WF301": (lambda t: _race_pipe(guarded=False),
               lambda t: _race_pipe(guarded=True)),
     "WF302": (lambda t: _global_pipe(True),
               lambda t: _global_pipe(False)),
+    "WF303": (lambda t: _replay_pipe("time"),
+              lambda t: _replay_pipe("rng")),
+    "WF304": (lambda t: _replay_pipe("file"),
+              lambda t: _replay_pipe("pure")),
+    "WF305": (lambda t: _latency_pipe(t, blocking=True),
+              lambda t: _latency_pipe(t, blocking=False)),
 }
 
 
@@ -516,6 +604,92 @@ def test_directive_parser():
     assert parse_directive("# wf-lint: disable=WF30l") == set()
 
 
+# ------------------------------------------------- effect analyzer (WF30x)
+
+def _stamp_helper():
+    return time.time()
+
+
+def test_effects_seeded_generator_exempt():
+    """A fn that captures a seeded Generator is trusted for WF303 —
+    seeded-generator state rides the snapshot, the blessed pattern."""
+    from windflow_tpu.check.effects import analyze_effects
+
+    def bad(b):
+        np.random.shuffle(b)
+
+    def good(b, _rng=np.random.default_rng(7)):
+        np.random.shuffle(b)
+
+    assert any(d.code == "WF303"
+               for d in analyze_effects(bad, {"WF303"}, "kf"))
+    assert analyze_effects(good, {"WF303"}, "kf") == []
+
+
+def test_effects_helper_following():
+    """One level of same-module call following: a helper defined next
+    to the user fn is scanned too, reported 'via helper'."""
+    from windflow_tpu.check.effects import analyze_effects
+
+    def fn(b):
+        return _stamp_helper()
+
+    ds = analyze_effects(fn, {"WF303"}, "m")
+    assert ds and ds[0].code == "WF303"
+    assert "via helper" in ds[0].message
+    assert "_stamp_helper" in ds[0].message
+
+
+def test_effects_blocking_acquire_untimed_only():
+    """WF305's name heuristic: an untimed .acquire() flags, a timed one
+    (bounded wait) does not."""
+    from windflow_tpu.check.effects import analyze_effects
+    lk = threading.Lock()
+
+    def bad(b):
+        lk.acquire()
+        lk.release()
+
+    def good(b):
+        if lk.acquire(timeout=0.1):
+            lk.release()
+
+    assert any(d.code == "WF305"
+               for d in analyze_effects(bad, {"WF305"}, "svc"))
+    assert analyze_effects(good, {"WF305"}, "svc") == []
+
+
+def test_effects_gating_by_contract(tmp_path):
+    """A blocking fn under a depth-triggered Rescale (no up_q95_us/
+    up_slo_burn) must NOT arm WF305 — the rule does not watch latency."""
+    report = validate(_latency_pipe(tmp_path, blocking=True,
+                                    latency=False))
+    assert "WF305" not in report.codes(), report.render()
+
+
+def test_effects_suppression_directive(tmp_path):
+    """# wf-lint: disable=WF303 on the call line suppresses, same as
+    the closure analyzer's directives."""
+    from windflow_tpu.check.effects import analyze_effects
+    mod = tmp_path / "eff_sup.py"
+    mod.write_text(textwrap.dedent("""
+        import time
+
+        def noisy(b):
+            return time.time()
+
+        def quiet(b):
+            return time.time()   # wf-lint: disable=WF303
+    """))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("eff_sup", str(mod))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    assert any(d.code == "WF303"
+               for d in analyze_effects(m.noisy, {"WF303"}, "n"))
+    assert analyze_effects(m.quiet, {"WF303"}, "n") == []
+
+
 # ------------------------------------------------------------- self-lint
 
 APP_MODULES = ("windflow_tpu.apps.micro", "windflow_tpu.apps.pipe",
@@ -534,6 +708,36 @@ def test_bench_apps_self_lint(modname):
         report = validate(target)
         assert len(report) == 0, (
             f"{modname}: {report.render()}")
+
+
+SOAK_SCRIPTS = ("soak_overload.py", "soak_crash.py", "soak_rescale.py",
+                "soak_wire.py", "soak_handoff.py", "wf_roll.py")
+
+
+def _load_script(fname):
+    import importlib.util
+    path = os.path.join(REPO, "scripts", fname)
+    name = os.path.splitext(fname)[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("fname", SOAK_SCRIPTS)
+def test_soak_scripts_self_lint(fname):
+    """Tier-1 gate (ISSUE 20): the soak/roll scripts validate
+    diagnostic-free through their wf_check_pipelines() hooks — incl.
+    the new WF30x effect analysis over their recovery-opted sinks and
+    the WF22x plane lint of soak_handoff's declared topology."""
+    mod = _load_script(fname)
+    targets = mod.wf_check_pipelines()
+    assert targets
+    for target in targets:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            report = validate(target)
+        assert len(report) == 0, f"{fname}: {report.render()}"
 
 
 # ------------------------------------------------------------ wf-lint CLI
@@ -576,3 +780,80 @@ def test_wf_lint_cli_apps_clean():
     r = _run_lint(["--error", *APP_MODULES])
     assert r.returncode == 0, r.stdout + r.stderr
     assert "0 diagnostic(s)" in r.stdout
+
+
+def test_wf_lint_cli_plane_corpus():
+    """Acceptance (ISSUE 20): --plane over the seeded misconfigured
+    2-host spec reports the full planted WF22x + cross-host set; the
+    minimally-fixed twin reports zero."""
+    r = _run_lint(["--plane", "tests/plane_corpus.py", "--error"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    import importlib.util
+    path = os.path.join(REPO, "tests", "plane_corpus.py")
+    spec = importlib.util.spec_from_file_location("plane_corpus", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for code in mod.PLANTED:
+        assert code in r.stdout, (
+            f"{code} missing from --plane output:\n{r.stdout}\n{r.stderr}")
+
+    r2 = _run_lint(["--plane", "tests/plane_corpus_fixed.py", "--error"])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "0 diagnostic(s)" in r2.stdout
+
+
+def test_wf_lint_cli_json():
+    """--json emits one machine-readable document: every planted id of
+    the misconfig corpus as {id, severity, module, target, message}
+    records plus the target count."""
+    r = _run_lint(["tests/check_corpus.py", "--json"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["targets"] > 0
+    recs = doc["diagnostics"]
+    corpus = _load_corpus()
+    assert set(corpus.PLANTED) <= {d["id"] for d in recs}
+    for d in recs:
+        assert {"id", "severity", "module", "target", "message"} <= set(d)
+    anchored = [d for d in recs if "file" in d]
+    assert anchored and all(isinstance(d["line"], int) for d in anchored)
+
+
+def test_wf_lint_cli_module_scan_fallback(tmp_path):
+    """A manual-graph script with NO wf_check_pipelines() hook is still
+    lintable: module-level Dataflow objects are picked up by the
+    fallback scan (here a round-robin emitter over keyed state ->
+    WF101)."""
+    mod = tmp_path / "manual_graph.py"
+    mod.write_text(textwrap.dedent("""
+        import numpy as np
+        from windflow_tpu.core.tuples import Schema
+        from windflow_tpu.patterns.basic import _AccumulatorNode
+        from windflow_tpu.runtime.emitters import StandardEmitter
+        from windflow_tpu.runtime.engine import Dataflow
+
+        S = Schema(value=np.int64)
+        DF = Dataflow("manual")
+        _em = DF.add(StandardEmitter(2, None, name="em"))
+        _a = DF.add(_AccumulatorNode(lambda row, acc: None, None, S,
+                                     "acc.0", rich=False))
+        _b = DF.add(_AccumulatorNode(lambda row, acc: None, None, S,
+                                     "acc.1", rich=False))
+        DF.connect(_em, _a)
+        DF.connect(_em, _b)
+    """))
+    r = _run_lint([str(mod), "--error"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "WF101" in r.stdout
+
+
+def test_wf_lint_cli_exit2_contract():
+    """Usage/import failures exit 2, distinct from 'findings' (1) and
+    'clean' (0) — the documented scriptable contract."""
+    r = _run_lint([])
+    assert r.returncode == 2
+    r = _run_lint(["tests/no_such_module_xyz.py"])
+    assert r.returncode == 2
+    # a module with no lintable targets is a usage error too
+    r = _run_lint(["tests/oracle.py"])
+    assert r.returncode == 2

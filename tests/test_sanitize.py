@@ -1,0 +1,32 @@
+"""In-suite slice of the native sanitizer lane (scripts/wf_sanitize.py):
+build the instrumented stress driver and run a small seeded corpus under
+each sanitizer.  Slow-marked — each lane pays a full compile of
+wf_native.cpp under -fsanitize."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("make") is None or shutil.which("g++") is None,
+    reason="no native toolchain")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("san", ["tsan", "asan"])
+def test_sanitizer_stress_lane(san):
+    """The lane must build its instrumented binary and run the seeded
+    stress corpus with zero sanitizer reports and zero stress-assertion
+    failures."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "wf_sanitize.py"),
+         "--san", san, "--n", "2", "--seed", "11"],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (
+        f"sanitizer lane {san} failed:\n{proc.stdout}\n{proc.stderr}")
+    assert "OK" in proc.stdout

@@ -55,6 +55,11 @@ def validate(target) -> CheckReport:
         # module un-imported until a supervisor is actually built)
         report.extend(check_plane(target))
         return report.finish()
+    if kind == "PlaneSpec":
+        # declared multi-host topology (check/plane.py, WF22x)
+        from .plane import check_plane_spec
+        report.extend(check_plane_spec(target))
+        return report.finish()
     if hasattr(target, "_build") and hasattr(target, "_stages"):
         # a MultiPipe: pre-build knob checks first — a fatal knob
         # conflict (WF208 at the Dataflow constructor, WF210/WF211 at

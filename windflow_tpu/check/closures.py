@@ -35,10 +35,29 @@ from .directives import suppressed_at
 _MUTATORS = frozenset({
     "append", "extend", "insert", "remove", "pop", "popitem", "clear",
     "update", "setdefault", "add", "discard", "sort", "reverse",
-    "appendleft", "extendleft", "popleft", "fill", "put", "__setitem__",
+    "appendleft", "extendleft", "popleft", "rotate", "fill", "put",
+    "__setitem__",
 })
 
-_MUTABLE_TYPES = (list, dict, set, bytearray)
+import collections as _collections  # noqa: E402  (stdlib, import-light)
+
+_MUTABLE_TYPES = (list, dict, set, bytearray, _collections.deque)
+
+#: 3.10 spells augmented assignment as dedicated opcodes; 3.11+ folds
+#: them into BINARY_OP whose argrepr carries the ``=`` (e.g. ``+=``)
+_INPLACE_OPS = frozenset({
+    "INPLACE_ADD", "INPLACE_SUBTRACT", "INPLACE_MULTIPLY",
+    "INPLACE_TRUE_DIVIDE", "INPLACE_FLOOR_DIVIDE", "INPLACE_MODULO",
+    "INPLACE_POWER", "INPLACE_LSHIFT", "INPLACE_RSHIFT", "INPLACE_AND",
+    "INPLACE_OR", "INPLACE_XOR", "INPLACE_MATRIX_MULTIPLY",
+})
+
+
+def _is_inplace(ins) -> bool:
+    if ins.opname in _INPLACE_OPS:
+        return True
+    return (ins.opname == "BINARY_OP"
+            and "=" in (getattr(ins, "argrepr", "") or ""))
 
 
 def _is_mutable_cell(value) -> bool:
@@ -106,7 +125,11 @@ def analyze_function(fn, shared_by: int, owner: str) -> list[Diagnostic]:
     armed: dict[str, int] = {}    # container itself on the stack
     derived: dict[str, int] = {}  # value read OUT of a closed container
     pending_method: tuple[str, int] | None = None
-    prev = ""
+    #: (var, line) pairs already reported as in-place mutations — the
+    #: compiler follows the INPLACE op with a STORE_DEREF rebind of the
+    #: same name, which must not double-flag
+    inplace_hit: set[tuple[str, int]] = set()
+    prev = prev_val = ""
     for ins in dis.get_instructions(code):
         sl = ins.starts_line
         if sl:   # int on <= 3.12, True on 3.13+ (line_number carries it)
@@ -127,13 +150,13 @@ def analyze_function(fn, shared_by: int, owner: str) -> list[Diagnostic]:
             # (DUP_TOP_TWO), so the container stays the store's target.
             derived.update(armed)
             armed.clear()
-        prev = op
         if op in ("STORE_DEREF", "DELETE_DEREF") \
                 and ins.argval in code.co_freevars:
-            flag("WF301",
-                 f"{fname!r} ({owner}, parallelism {shared_by}) rebinds "
-                 f"closed-over {ins.argval!r} from parallel replicas",
-                 line)
+            if (ins.argval, line) not in inplace_hit:
+                flag("WF301",
+                     f"{fname!r} ({owner}, parallelism {shared_by}) "
+                     f"rebinds closed-over {ins.argval!r} from parallel "
+                     f"replicas", line)
         elif op in ("STORE_GLOBAL", "DELETE_GLOBAL"):
             flag("WF302",
                  f"{fname!r} ({owner}, parallelism {shared_by}) rebinds "
@@ -141,6 +164,19 @@ def analyze_function(fn, shared_by: int, owner: str) -> list[Diagnostic]:
                  line)
         elif op == "LOAD_DEREF" and ins.argval in mutable:
             armed[ins.argval] = line
+        elif _is_inplace(ins) and armed:
+            # `closed[k] += v` / `closed += [v]`: the in-place op runs
+            # on the shared container (read-modify-write, the classic
+            # lost-increment race).  Consume `armed` so the compiler's
+            # trailing STORE_SUBSCR does not flag the same site twice.
+            var, at = next(iter(armed.items()))
+            flag("WF301",
+                 f"{fname!r} ({owner}, parallelism {shared_by}) "
+                 f"augments closed-over {type(cells[var]).__name__} "
+                 f"{var!r} in place (read-modify-write) from parallel "
+                 f"replicas", at)
+            inplace_hit.add((var, at))
+            armed.clear()
         elif op in ("STORE_SUBSCR", "DELETE_SUBSCR") and armed:
             var, at = next(iter(armed.items()))
             flag("WF301",
@@ -149,11 +185,19 @@ def analyze_function(fn, shared_by: int, owner: str) -> list[Diagnostic]:
                  f"{var!r} from parallel replicas", at)
             armed.clear()
         elif op in ("LOAD_METHOD", "LOAD_ATTR") and (armed or derived):
-            if ins.argval in _MUTATORS:
-                var, at = next(iter((armed or derived).items()))
-                pending_method = (var, at)
-            armed.clear()
-            derived.clear()
+            # receiver-aware: the attribute is ON the shared container
+            # only when the previous instruction put that container (or
+            # a value read out of it) on top of the stack — an
+            # unrelated receiver (`counts[b.x] += 1` loading `b.x`)
+            # must not disarm the pending container
+            on_container = ((prev == "LOAD_DEREF" and prev_val in armed)
+                            or (prev == "BINARY_SUBSCR" and derived))
+            if on_container:
+                if ins.argval in _MUTATORS:
+                    var, at = next(iter((armed or derived).items()))
+                    pending_method = (var, at)
+                armed.clear()
+                derived.clear()
         elif op.startswith("CALL") and pending_method is not None:
             var, at = pending_method
             flag("WF301",
@@ -162,4 +206,5 @@ def analyze_function(fn, shared_by: int, owner: str) -> list[Diagnostic]:
                  f"{type(cells[var]).__name__} {var!r} from parallel "
                  f"replicas", at)
             pending_method = None
+        prev, prev_val = op, ins.argval
     return diags

@@ -95,6 +95,29 @@ CATALOG: dict[str, tuple[str, str]] = {
               "federate= set without metrics=/sample_period=: the "
               "shipper's only source is the sampler, so no snapshot is "
               "ever shipped and federation is silently inert"),
+    # -- WF22x: plane topology (cross-process, check/plane.py) ----------
+    "WF220": (ERROR,
+              "plane topology broken: a host ships rows to a pid with "
+              "no declared address/spec, two hosts claim one address, "
+              "or the address book and host specs disagree on the pid "
+              "set"),
+    "WF221": (ERROR,
+              "row dtype mismatch across a plane edge: the sender's "
+              "row dtype is not what the receiver expects, so every "
+              "decoded batch is garbage (or the decoder rejects it)"),
+    "WF222": (ERROR,
+              "resume= on only one end of a plane edge: a journaling "
+              "sender facing a non-resuming receiver (or vice versa) "
+              "breaks the resume handshake at reconnect"),
+    "WF223": (WARNING,
+              "PlanePolicy supervision declared but no host offers a "
+              "ckpt_sink/portable-spool replica target: a takeover has "
+              "no portable checkpoint to restore from, so cross-host "
+              "recovery silently degrades to an empty restart"),
+    "WF224": (ERROR,
+              "federation shipping misrouted: a host federates but no "
+              "host aggregates the plane's telemetry, or two hosts "
+              "claim the aggregator role for one plane"),
     # -- WF3xx: closure race analysis -----------------------------------
     "WF301": (WARNING,
               "user function shared by parallel replicas mutates "
@@ -102,6 +125,21 @@ CATALOG: dict[str, tuple[str, str]] = {
     "WF302": (WARNING,
               "user function shared by parallel replicas rebinds a "
               "module global: probable data race"),
+    # -- WF30x: effect analysis (check/effects.py) ----------------------
+    "WF303": (WARNING,
+              "nondeterministic call (time/random/uuid/os.urandom/"
+              "numpy RNG) in a recovery=-recoverable node without a "
+              "captured seeded generator: replay after a crash "
+              "re-executes the fn and diverges from the journal"),
+    "WF304": (WARNING,
+              "external side effect (file/socket/subprocess/HTTP) in a "
+              "node opted into restart: replay re-fires the effect — "
+              "no downstream edge can deduplicate it"),
+    "WF305": (WARNING,
+              "blocking call (sleep/untimed acquire/blocking recv) in "
+              "a node governed by a latency-triggered Rescale rule: "
+              "self-inflicted q95/SLO-burn skew triggers phantom "
+              "rescales"),
 }
 
 

@@ -246,6 +246,53 @@ def _check_closures(df) -> list[Diagnostic]:
     return diags
 
 
+def _check_effects(df) -> list[Diagnostic]:
+    """WF303/304/305 (check/effects.py) over user functions whose node
+    contracts arm an effect family: recovery+recoverable arms the
+    replay checks, a latency-triggered Rescale rule arms the blocking
+    check.  One finding per (pattern, call site) — farm replicas share
+    the fn, so the walk dedups by pattern name."""
+    from .effects import analyze_effects
+
+    ctl = df.control
+    diags = []
+    seen: set[tuple] = set()
+    for node in df.nodes:
+        for leaf in _leaf_nodes(node):
+            fns = []
+            fn = getattr(leaf, "fn", None)
+            if fn is not None and hasattr(fn, "__code__"):
+                fns.append(fn)
+            wfn = getattr(getattr(_core_of(leaf), "winfunc", None),
+                          "fn", None)
+            if wfn is not None and hasattr(wfn, "__code__"):
+                fns.append(wfn)
+            if not fns:
+                continue
+            owner = leaf.name.rsplit(".", 1)[0]
+            active = set()
+            if (df.recovery is not None
+                    and getattr(leaf, "recoverable", False)):
+                active |= {"WF303", "WF304"}
+            if ctl is not None and hasattr(ctl, "rescale_for"):
+                rule = ctl.rescale_for(owner)
+                if rule is not None and (
+                        getattr(rule, "up_q95_us", None) is not None
+                        or getattr(rule, "up_slo_burn", None)
+                        is not None):
+                    active.add("WF305")
+            if not active:
+                continue
+            for f in fns:
+                for d in analyze_effects(f, active, owner):
+                    key = (d.code, owner, d.anchor)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    diags.append(d)
+    return diags
+
+
 def check_dataflow(df, skip_config: bool = False) -> list[Diagnostic]:
     """Every graph-level pass over a built Dataflow; ``skip_config``
     when the caller already ran the pipe-level knob checks (avoids
@@ -257,4 +304,5 @@ def check_dataflow(df, skip_config: bool = False) -> list[Diagnostic]:
     diags.extend(_check_routing(df))
     diags.extend(_check_windows(df))
     diags.extend(_check_closures(df))
+    diags.extend(_check_effects(df))
     return diags
